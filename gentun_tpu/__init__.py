@@ -1,0 +1,80 @@
+"""gentun_tpu — TPU-native distributed genetic-algorithm search.
+
+A brand-new framework with the capabilities of gentun (hyperparameter and
+CNN-architecture search via genetic algorithms, distributed master/worker
+fitness evaluation), designed TPU-first on JAX/Flax/XLA:
+
+- deterministic, PRNG-threaded GA engine (``genes``, ``individuals``,
+  ``populations``, ``algorithms``),
+- Genetic-CNN fitness as a *masked supergraph*: every genome shares one
+  compiled XLA program, and whole populations train as a single vmapped
+  batch (``ops``, ``models``),
+- multi-chip scaling via ``jax.sharding`` meshes — population-parallel ×
+  data-parallel (``parallel``),
+- a master/worker job broker over TCP with at-least-once redelivery, the
+  RabbitMQ-equivalent control plane (``distributed``).
+
+Public API mirrors the reference (``gentun/__init__.py`` [PUB]; SURVEY.md
+§2.0 row 1): model-dependent names are re-exported defensively so a missing
+optional dependency never breaks ``import gentun_tpu``.
+"""
+
+from .genes import (
+    BinaryGene,
+    ChoiceGene,
+    FloatGene,
+    GenomeSpec,
+    IntGene,
+    boosting_genome,
+    genetic_cnn_genome,
+    xgboost_genome,
+)
+from .individuals import BoostingIndividual, GeneticCnnIndividual, Individual, XgboostIndividual
+from .populations import GridPopulation, Population
+from .algorithms import GeneticAlgorithm, RussianRouletteGA
+
+__all__ = [
+    "BinaryGene",
+    "FloatGene",
+    "IntGene",
+    "ChoiceGene",
+    "GenomeSpec",
+    "genetic_cnn_genome",
+    "boosting_genome",
+    "xgboost_genome",
+    "Individual",
+    "GeneticCnnIndividual",
+    "BoostingIndividual",
+    "XgboostIndividual",
+    "Population",
+    "GridPopulation",
+    "GeneticAlgorithm",
+    "RussianRouletteGA",
+]
+
+__version__ = "0.1.0"
+
+# Fitness models pull in jax/flax/sklearn; keep them optional at import time,
+# matching the reference's try/except around model imports (SURVEY.md §2.0
+# row 1: missing xgboost/keras must not break the package import).
+try:  # pragma: no cover - exercised implicitly
+    from .models.cnn import GeneticCnnModel  # noqa: F401
+
+    __all__.append("GeneticCnnModel")
+except Exception:  # pragma: no cover
+    pass
+
+try:  # pragma: no cover
+    from .models.boosting import BoostingModel  # noqa: F401
+
+    __all__.append("BoostingModel")
+except Exception:  # pragma: no cover
+    pass
+
+try:  # pragma: no cover
+    from .distributed.server import DistributedPopulation  # noqa: F401
+    from .distributed.client import GentunClient  # noqa: F401
+
+    __all__ += ["DistributedPopulation", "GentunClient"]
+except Exception:  # pragma: no cover
+    pass

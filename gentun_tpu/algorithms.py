@@ -1,0 +1,239 @@
+"""GA outer loops: generational evolution with selection/elitism.
+
+Reference parity: ``GeneticAlgorithm`` and ``RussianRouletteGA`` in
+``gentun/algorithms.py`` [PUB] (SURVEY.md §2.0 rows 2-3, §3.1).  The outer
+loop is deliberately identical in shape to the reference — evaluate the
+population, log the fittest, select parents, reproduce into the next
+generation — because the north star keeps it "untouched" (BASELINE.json).
+
+What's new versus the reference:
+
+- explicit seeded RNG (reproducible searches),
+- structured per-generation records including the north-star metric,
+  individuals evaluated per hour (SURVEY.md §5 "Metrics"),
+- optional generation-boundary checkpointing (SURVEY.md §5
+  "Checkpoint / resume" — absent in the reference, required by the rebuild).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .individuals import Individual
+from .populations import Population
+
+__all__ = ["GeneticAlgorithm", "RussianRouletteGA"]
+
+logger = logging.getLogger("gentun_tpu")
+
+
+def _initialized_chip_count() -> int:
+    """Local accelerator count, WITHOUT triggering jax backend init.
+
+    The GA outer loop is pure bookkeeping; it must not pay (or hang on) TPU
+    runtime initialization just to normalise a metric.  Only consult jax when
+    the fitness path has already initialized a backend.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        return 1
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:  # backend never initialized: don't force it
+            return 1
+        return sys.modules["jax"].local_device_count()
+    except Exception:  # pragma: no cover - private-API drift
+        return 1
+
+
+class GeneticAlgorithm:
+    """Tournament-selection GA with elitism (gentun ``GeneticAlgorithm`` [PUB]).
+
+    Per generation: evaluate every individual (lazily/cached), keep the best
+    unchanged if ``elitism``, then fill the next generation with children of
+    tournament-selected parents (sample ``tournament_size`` members, fittest
+    wins — SURVEY.md §2.3 "Selection").
+    """
+
+    def __init__(
+        self,
+        population: Population,
+        tournament_size: int = 5,
+        elitism: bool = True,
+        seed: Optional[int] = None,
+    ):
+        self.population = population
+        self.tournament_size = tournament_size
+        self.elitism = elitism
+        self.rng = np.random.default_rng(seed) if seed is not None else population.rng
+        self.generation = 0
+        self.history: List[Dict[str, Any]] = []
+        self._checkpointer = None
+
+    # -- checkpointing hook (wired by utils.checkpoint) --------------------
+
+    def set_checkpointer(self, checkpointer) -> None:
+        """Attach a generation-boundary checkpointer (``utils/checkpoint.py``)."""
+        self._checkpointer = checkpointer
+
+    # -- selection ---------------------------------------------------------
+
+    def select_parent(self) -> Individual:
+        """Tournament selection: sample t individuals, fittest wins."""
+        size = len(self.population)
+        t = min(self.tournament_size, size)
+        idx = self.rng.choice(size, size=t, replace=False)
+        contenders = [self.population[int(i)] for i in idx]
+        key = lambda ind: ind.get_fitness()
+        return max(contenders, key=key) if self.population.maximize else min(contenders, key=key)
+
+    # -- evolution ---------------------------------------------------------
+
+    def evolve_population(self) -> None:
+        """One generation step: evaluate → select → reproduce (SURVEY.md §3.1)."""
+        t0 = time.monotonic()
+        # Count only the individuals actually trained this step (cached elites
+        # and distributed pre-assigned fitnesses don't inflate the metric).
+        evaluated = sum(1 for ind in self.population if not ind.fitness_evaluated)
+        self.population.evaluate()
+        fittest = self.population.get_fittest()
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        self._log_generation(fittest, evaluated, elapsed)
+
+        next_individuals: List[Individual] = []
+        if self.elitism:
+            next_individuals.append(fittest.copy())  # keeps cached fitness
+        while len(next_individuals) < len(self.population):
+            mother = self.select_parent()
+            father = self.select_parent()
+            next_individuals.append(mother.reproduce(father, self.rng))
+
+        self.population = Population(
+            species=self.population.species,
+            x_train=self.population.x_train,
+            y_train=self.population.y_train,
+            individual_list=next_individuals,
+            crossover_rate=self.population.crossover_rate,
+            mutation_rate=self.population.mutation_rate,
+            maximize=self.population.maximize,
+            additional_parameters=self.population.additional_parameters,
+            rng=self.population.rng,
+        )
+        self.generation += 1
+        if self._checkpointer is not None:
+            self._checkpointer.save(self)
+
+    def run(self, max_generations: int) -> Individual:
+        """Run the search; returns the final fittest individual.
+
+        Matches the reference's entry point
+        ``GeneticAlgorithm(population).run(n)`` (SURVEY.md §3.1).
+        """
+        logger.info(
+            "starting %s: population=%d, generations=%d",
+            type(self).__name__,
+            len(self.population),
+            max_generations,
+        )
+        for _ in range(max_generations):
+            self.evolve_population()
+        self.population.evaluate()
+        best = self.population.get_fittest()
+        logger.info("search done: best fitness %.6g, genes %s", best.get_fitness(), best.get_genes())
+        return best
+
+    # -- logging -----------------------------------------------------------
+
+    def _log_generation(self, fittest: Individual, evaluated: int, elapsed_s: float) -> None:
+        n_chips = _initialized_chip_count()
+        record = {
+            "generation": self.generation,
+            "best_fitness": fittest.get_fitness(),
+            "best_genes": fittest.get_genes(),
+            "population_size": len(self.population),
+            "eval_wall_s": round(elapsed_s, 3),
+            # the north-star metric (BASELINE.json): individuals/hour/chip
+            "individuals_per_hour_per_chip": round(evaluated / (elapsed_s / 3600.0) / n_chips, 2),
+        }
+        self.history.append(record)
+        logger.info("generation %s", json.dumps(record, default=str))
+
+    # -- (de)serialization state for checkpoint/resume ---------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "algorithm": type(self).__name__,
+            "generation": self.generation,
+            "tournament_size": self.tournament_size,
+            "elitism": self.elitism,
+            "rng_state": self.rng.bit_generator.state,
+            "history": self.history,
+            "population": {
+                "maximize": self.population.maximize,
+                "crossover_rate": self.population.crossover_rate,
+                "mutation_rate": self.population.mutation_rate,
+                "additional_parameters": self.population.additional_parameters,
+                "individuals": [
+                    {
+                        "genes": ind.get_genes(),
+                        "fitness": ind._fitness,
+                    }
+                    for ind in self.population
+                ],
+            },
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.generation = int(state["generation"])
+        self.tournament_size = int(state["tournament_size"])
+        self.elitism = bool(state["elitism"])
+        self.rng.bit_generator.state = state["rng_state"]
+        self.history = list(state["history"])
+        pop_state = state["population"]
+        # Restore population config BEFORE spawning, so individuals are built
+        # with the checkpoint's genome spec and operator rates, not whatever
+        # config the receiving population happened to be constructed with.
+        self.population.maximize = bool(pop_state["maximize"])
+        self.population.crossover_rate = float(pop_state["crossover_rate"])
+        self.population.mutation_rate = float(pop_state["mutation_rate"])
+        self.population.additional_parameters = dict(pop_state["additional_parameters"])
+        individuals = []
+        for ind_state in pop_state["individuals"]:
+            ind = self.population.spawn(genes=ind_state["genes"])
+            if ind_state["fitness"] is not None:
+                ind.set_fitness(ind_state["fitness"])
+            individuals.append(ind)
+        self.population.individuals = individuals
+
+
+class RussianRouletteGA(GeneticAlgorithm):
+    """Fitness-proportional (roulette) selection, per the Genetic-CNN paper.
+
+    gentun ``RussianRouletteGA`` [BASELINE names it; PUB for mechanism]
+    (SURVEY.md §2.0 row 3).  Parents are drawn with probability proportional
+    to fitness (shifted to be positive; inverted when minimising), instead of
+    by tournament.
+    """
+
+    def _selection_weights(self) -> np.ndarray:
+        fits = np.asarray(self.population.get_fitnesses(), dtype=np.float64)
+        if not self.population.maximize:
+            fits = -fits
+        # Shift so the worst member still has a small non-zero chance.
+        lo, hi = fits.min(), fits.max()
+        if hi == lo:
+            return np.full(len(fits), 1.0 / len(fits))
+        shifted = fits - lo + 0.1 * (hi - lo)
+        return shifted / shifted.sum()
+
+    def select_parent(self) -> Individual:
+        weights = self._selection_weights()
+        idx = int(self.rng.choice(len(self.population), p=weights))
+        return self.population[idx]

@@ -1,0 +1,220 @@
+"""Individuals: a genome value + lazy, cached fitness evaluation.
+
+Reference parity: gentun's ``Individual`` ABC and its two species,
+``XgboostIndividual`` and ``GeneticCnnIndividual`` (``gentun/individuals.py``
+[PUB]; SURVEY.md §2.0 rows 5-7).  The reference's key behaviors preserved here:
+
+- ``get_fitness()`` is lazy and cached — an individual trains its model at
+  most once; reproduction produces children with fitness unset, so unchanged
+  elites are never re-trained (SURVEY.md §2.3 "Fitness caching").
+- ``reproduce(partner)`` = uniform per-gene crossover then mutation, returning
+  a *new* individual.
+- ``additional_parameters`` is the de-facto config schema: every non-genome
+  knob (stage sizes, epochs, k-fold count, ...) travels in this dict, and it
+  must survive serialization to workers (SURVEY.md §5 "Config / flag system").
+
+The rebuild differs in one deliberate way: randomness is never global.  Every
+stochastic method takes or holds an explicit ``numpy.random.Generator``.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Dict, Mapping, Optional, Type
+
+import numpy as np
+
+from .genes import GenomeSpec, boosting_genome, genetic_cnn_genome
+
+__all__ = ["Individual", "GeneticCnnIndividual", "BoostingIndividual", "XgboostIndividual"]
+
+
+class Individual:
+    """A candidate solution: genome dict + lazily evaluated fitness.
+
+    Subclasses define :meth:`build_spec` (the genome) and :meth:`evaluate`
+    (train the fitness model and return a scalar).  ``x_train``/``y_train``
+    are held by the individual, mirroring the reference's design where the
+    *data* stays local and only genes cross process boundaries (SURVEY.md §1).
+    """
+
+    def __init__(
+        self,
+        x_train=None,
+        y_train=None,
+        genes: Optional[Mapping[str, Any]] = None,
+        crossover_rate: float = 0.5,
+        mutation_rate: float = 0.015,
+        maximize: bool = True,
+        rng: Optional[np.random.Generator] = None,
+        additional_parameters: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        self.x_train = x_train
+        self.y_train = y_train
+        self.crossover_rate = crossover_rate
+        self.mutation_rate = mutation_rate
+        self.maximize = maximize
+        self.additional_parameters: Dict[str, Any] = dict(additional_parameters or {})
+        # Extra kwargs fold into additional_parameters, matching gentun's habit
+        # of passing model knobs straight through the individual constructor.
+        self.additional_parameters.update(kwargs)
+        self._rng = rng if rng is not None else np.random.default_rng()
+        self.spec: GenomeSpec = self.build_spec(**self.additional_parameters)
+        if genes is None:
+            self.genes: Dict[str, Any] = self.spec.sample(self._rng)
+        else:
+            self.genes = self.spec.validate(genes)
+        self._fitness: Optional[float] = None
+
+    # -- genome ------------------------------------------------------------
+
+    def build_spec(self, **params) -> GenomeSpec:
+        raise NotImplementedError
+
+    def get_genes(self) -> Dict[str, Any]:
+        return dict(self.genes)
+
+    def set_genes(self, genes: Mapping[str, Any]) -> None:
+        self.genes = self.spec.validate(genes)
+        self._fitness = None
+
+    # -- fitness -----------------------------------------------------------
+
+    def evaluate(self) -> float:
+        """Train the fitness model; subclass hot path (SURVEY.md §3.1)."""
+        raise NotImplementedError
+
+    def get_fitness(self) -> float:
+        """Lazy, cached fitness (gentun ``Individual.get_fitness`` [PUB])."""
+        if self._fitness is None:
+            self._fitness = float(self.evaluate())
+        return self._fitness
+
+    def set_fitness(self, fitness: float) -> None:
+        """Write fitness from outside — used by the distributed master when a
+        worker's reply arrives (SURVEY.md §3.2)."""
+        self._fitness = float(fitness)
+
+    @property
+    def fitness_evaluated(self) -> bool:
+        return self._fitness is not None
+
+    # -- genetic operators -------------------------------------------------
+
+    def crossover(self, partner: "Individual", rng: Optional[np.random.Generator] = None) -> "Individual":
+        """Uniform per-gene crossover; returns a child with fitness unset."""
+        rng = rng if rng is not None else self._rng
+        child_genes = self.spec.crossover(self.genes, partner.genes, rng, self.crossover_rate)
+        return self.copy(genes=child_genes)
+
+    def mutate(self, rng: Optional[np.random.Generator] = None) -> "Individual":
+        """Mutate in place (resets cached fitness); returns self for chaining."""
+        rng = rng if rng is not None else self._rng
+        new_genes = self.spec.mutate(self.genes, rng, self.mutation_rate)
+        if new_genes != self.genes:
+            self.genes = new_genes
+            self._fitness = None
+        return self
+
+    def reproduce(self, partner: "Individual", rng: Optional[np.random.Generator] = None) -> "Individual":
+        """Crossover then mutation → new individual (gentun ``reproduce`` [PUB])."""
+        return self.crossover(partner, rng).mutate(rng)
+
+    def copy(self, genes: Optional[Mapping[str, Any]] = None) -> "Individual":
+        """Clone (sharing the data arrays, copying the genome).
+
+        A plain ``copy()`` keeps the cached fitness — that is what lets elites
+        survive generations without re-training (SURVEY.md §2.3).  Passing
+        explicit ``genes`` (the reproduction path) always yields an
+        unevaluated clone, matching the reference's "children have fitness
+        unset" semantics even when the child genome coincides with a parent's.
+        """
+        clone = type(self)(
+            x_train=self.x_train,
+            y_train=self.y_train,
+            genes=dict(self.genes) if genes is None else dict(genes),
+            crossover_rate=self.crossover_rate,
+            mutation_rate=self.mutation_rate,
+            maximize=self.maximize,
+            rng=self._rng,
+            additional_parameters=_copy.deepcopy(self.additional_parameters),
+        )
+        if genes is None:
+            clone._fitness = self._fitness
+        return clone
+
+    # -- misc --------------------------------------------------------------
+
+    def __repr__(self) -> str:
+        fit = f"{self._fitness:.6g}" if self._fitness is not None else "unevaluated"
+        return f"{type(self).__name__}(genes={self.genes}, fitness={fit})"
+
+
+class GeneticCnnIndividual(Individual):
+    """Genetic-CNN architecture-search individual.
+
+    Genome: one bit-string per stage encoding the intra-stage DAG
+    (gentun ``GeneticCnnIndividual`` [PUB]; SURVEY.md §2.0 row 7).  Fitness:
+    k-fold mean validation accuracy of the decoded CNN, trained TPU-side by
+    :class:`gentun_tpu.models.cnn.GeneticCnnModel`.
+
+    ``additional_parameters`` (all optional, with reference-shaped defaults —
+    SURVEY.md §3.4):  ``nodes``, ``input_shape``, ``kernels_per_layer``,
+    ``kfold``, ``epochs``, ``learning_rate``, ``batch_size``, ``dense_units``,
+    ``dropout_rate``, ``n_classes``.
+    """
+
+    #: set in tests to swap the fitness backend without touching the class
+    model_cls: Optional[Type] = None
+
+    def build_spec(self, **params) -> GenomeSpec:
+        return genetic_cnn_genome(tuple(params.get("nodes", (3, 5))))
+
+    def evaluate(self) -> float:
+        if self.x_train is None or self.y_train is None:
+            raise RuntimeError(
+                "this individual has no training data; in distributed mode "
+                "fitness must be assigned via set_fitness() from a worker reply"
+            )
+        model_cls = self.model_cls
+        if model_cls is None:
+            from .models.cnn import GeneticCnnModel as model_cls  # lazy: keeps jax import off the GA path
+        model = model_cls(self.x_train, self.y_train, self.genes, **self.additional_parameters)
+        return model.cross_validate()
+
+
+class BoostingIndividual(Individual):
+    """Gradient-boosting hyperparameter-search individual (control path).
+
+    The rebuild's counterpart of gentun's ``XgboostIndividual`` (SURVEY.md
+    §2.0 row 6), targeting sklearn ``HistGradientBoosting`` since xgboost is
+    not available in this environment (SURVEY.md §7 step 5).
+
+    ``additional_parameters``: ``kfold`` (default 5), ``metric``
+    (default "accuracy"), ``task`` ("classification" | "regression").
+    """
+
+    model_cls: Optional[Type] = None
+
+    def build_spec(self, **params) -> GenomeSpec:
+        return boosting_genome()
+
+    def evaluate(self) -> float:
+        if self.x_train is None or self.y_train is None:
+            raise RuntimeError(
+                "this individual has no training data; in distributed mode "
+                "fitness must be assigned via set_fitness() from a worker reply"
+            )
+        model_cls = self.model_cls
+        if model_cls is None:
+            from .models.boosting import BoostingModel as model_cls
+        model = model_cls(self.x_train, self.y_train, self.genes, **self.additional_parameters)
+        return model.cross_validate()
+
+
+#: Alias for API-level parity with the reference's species name
+#: (``XgboostIndividual`` in ``gentun/individuals.py`` [PUB]).  The genome
+#: differs (sklearn-shaped, see :func:`gentun_tpu.genes.boosting_genome`)
+#: because xgboost is not installed; the search semantics are identical.
+XgboostIndividual = BoostingIndividual

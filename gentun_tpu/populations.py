@@ -1,0 +1,213 @@
+"""Populations: collections of individuals sharing training data.
+
+Reference parity: ``Population`` and ``GridPopulation`` in
+``gentun/populations.py`` [PUB] (SURVEY.md §2.0 row 4).  A population holds
+the individuals plus the shared ``(x_train, y_train)`` and the ``maximize``
+flag; it knows how to random-init ``size`` individuals, enumerate a grid of
+gene values, and report the fittest member.
+
+TPU-first departure from the reference: :meth:`Population.evaluate` is a
+first-class population-level operation.  When the species' fitness model
+supports it, the *whole population* is evaluated in a single batched
+(vmapped) XLA program — every genome shares one compiled supergraph, so
+evaluating N individuals costs one compile + one batched train instead of N
+sequential Keras fits (SURVEY.md §7 "hard parts" #1, the main
+individuals/hour/chip lever).  The per-individual lazy path
+(``Individual.get_fitness``) still works and is what distributed workers use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Type
+
+import numpy as np
+
+from .individuals import Individual
+
+__all__ = ["Population", "GridPopulation"]
+
+
+class Population:
+    """A fixed-size set of individuals of one species.
+
+    Args mirror the reference constructor (``gentun/populations.py`` [PUB]):
+    ``species`` (the Individual subclass), shared data, either ``size`` for
+    random init or an explicit ``individual_list``, operator rates, the
+    optimisation direction, and ``additional_parameters`` forwarded to every
+    individual.  ``seed`` is new: it makes the whole run reproducible.
+    """
+
+    def __init__(
+        self,
+        species: Type[Individual],
+        x_train=None,
+        y_train=None,
+        individual_list: Optional[Sequence[Individual]] = None,
+        size: Optional[int] = None,
+        crossover_rate: float = 0.5,
+        mutation_rate: float = 0.015,
+        maximize: bool = True,
+        additional_parameters: Optional[Dict[str, Any]] = None,
+        seed: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.species = species
+        self.x_train = x_train
+        self.y_train = y_train
+        self.crossover_rate = crossover_rate
+        self.mutation_rate = mutation_rate
+        self.maximize = maximize
+        self.additional_parameters = dict(additional_parameters or {})
+        self.rng = rng if rng is not None else np.random.default_rng(seed)
+
+        if individual_list is not None:
+            self.individuals: List[Individual] = list(individual_list)
+        elif size is not None:
+            self.individuals = [self.spawn() for _ in range(size)]
+        else:
+            raise ValueError("provide either `size` or `individual_list`")
+
+    # -- construction ------------------------------------------------------
+
+    def spawn(self, genes: Optional[Mapping[str, Any]] = None) -> Individual:
+        """Create one individual of this population's species."""
+        return self.species(
+            x_train=self.x_train,
+            y_train=self.y_train,
+            genes=dict(genes) if genes is not None else None,
+            crossover_rate=self.crossover_rate,
+            mutation_rate=self.mutation_rate,
+            maximize=self.maximize,
+            rng=self.rng,
+            additional_parameters=dict(self.additional_parameters),
+        )
+
+    def add_individual(self, individual: Individual) -> None:
+        self.individuals.append(individual)
+
+    # -- container protocol (gentun exposes the same) ----------------------
+
+    def __len__(self) -> int:
+        return len(self.individuals)
+
+    def get_size(self) -> int:
+        return len(self.individuals)
+
+    def __getitem__(self, item: int) -> Individual:
+        return self.individuals[item]
+
+    def __iter__(self):
+        return iter(self.individuals)
+
+    def get_species(self) -> Type[Individual]:
+        return self.species
+
+    def get_data(self):
+        return self.x_train, self.y_train
+
+    # -- fitness -----------------------------------------------------------
+
+    def evaluate(self) -> None:
+        """Ensure every individual has a fitness.
+
+        Batched TPU path: if the species' fitness model exposes
+        ``cross_validate_population`` (see ``models/cnn.py``), all unevaluated
+        individuals with identical ``additional_parameters`` are trained in
+        one vmapped program.  Falls back to the reference's sequential lazy
+        loop otherwise (SURVEY.md §3.1).
+        """
+        pending = [ind for ind in self.individuals if not ind.fitness_evaluated]
+        if not pending:
+            return
+        if not self._evaluate_batched(pending):
+            for ind in pending:
+                ind.get_fitness()
+
+    def _evaluate_batched(self, pending: List[Individual]) -> bool:
+        """Try the single-program population evaluation; True on success."""
+        if self.x_train is None or self.y_train is None:
+            return False
+        model_cls = getattr(self.species, "model_cls", None)
+        if model_cls is None:
+            from .individuals import GeneticCnnIndividual
+
+            if not issubclass(self.species, GeneticCnnIndividual):
+                return False
+            try:
+                from .models.cnn import GeneticCnnModel
+            except Exception:  # pragma: no cover - jax missing
+                return False
+            model_cls = GeneticCnnModel
+        batch_fn = getattr(model_cls, "cross_validate_population", None)
+        if batch_fn is None:
+            return False
+        # Batched evaluation requires one shared config across the population;
+        # additional_parameters is population-level here, so that holds.
+        genomes = [ind.get_genes() for ind in pending]
+        fitnesses = batch_fn(self.x_train, self.y_train, genomes, **self.additional_parameters)
+        for ind, fit in zip(pending, fitnesses):
+            ind.set_fitness(float(fit))
+        return True
+
+    def get_fittest(self) -> Individual:
+        """Best individual under the population's direction (evaluating lazily)."""
+        self.evaluate()
+        key = lambda ind: ind.get_fitness()
+        return max(self.individuals, key=key) if self.maximize else min(self.individuals, key=key)
+
+    def get_fitnesses(self) -> List[float]:
+        self.evaluate()
+        return [ind.get_fitness() for ind in self.individuals]
+
+
+class GridPopulation(Population):
+    """Population initialised from the cartesian product of per-gene grids.
+
+    Mirrors gentun's ``GridPopulation`` (``gentun/populations.py`` [PUB];
+    SURVEY.md §2.3 "Initialization"): instead of random genomes, enumerate
+    every combination of the provided per-gene value lists.
+
+    ``genes_grid`` maps gene name → list of values; genes not present use
+    their full ``grid_values()`` (careful: binary genes enumerate 2**length).
+    """
+
+    def __init__(
+        self,
+        species: Type[Individual],
+        x_train=None,
+        y_train=None,
+        genes_grid: Optional[Mapping[str, Sequence[Any]]] = None,
+        crossover_rate: float = 0.5,
+        mutation_rate: float = 0.015,
+        maximize: bool = True,
+        additional_parameters: Optional[Dict[str, Any]] = None,
+        seed: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        super().__init__(
+            species,
+            x_train=x_train,
+            y_train=y_train,
+            individual_list=[],
+            crossover_rate=crossover_rate,
+            mutation_rate=mutation_rate,
+            maximize=maximize,
+            additional_parameters=additional_parameters,
+            seed=seed,
+            rng=rng,
+        )
+        # Need a spec to enumerate the grid; build a throwaway individual.
+        probe = self.spawn()
+        spec = probe.spec
+        genes_grid = dict(genes_grid or {})
+        unknown = [k for k in genes_grid if k not in spec]
+        if unknown:
+            raise ValueError(f"genes_grid has unknown genes: {unknown}")
+        axes: Dict[str, Sequence[Any]] = {}
+        for gene in spec.genes:
+            axes[gene.name] = list(genes_grid.get(gene.name, gene.grid_values()))
+        import itertools
+
+        names = list(axes)
+        for combo in itertools.product(*(axes[n] for n in names)):
+            self.add_individual(self.spawn(genes=dict(zip(names, combo))))

@@ -1,0 +1,161 @@
+"""GA engine tests: populations, selection, evolution (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from gentun_tpu.algorithms import GeneticAlgorithm, RussianRouletteGA
+from gentun_tpu.genes import genetic_cnn_genome
+from gentun_tpu.individuals import Individual
+from gentun_tpu.populations import GridPopulation, Population
+
+
+class OneMaxIndividual(Individual):
+    """Classic OneMax: fitness = number of 1 bits. A GA must solve this."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (5,))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+def make_population(size=12, seed=1, maximize=True, **params):
+    return Population(
+        OneMaxIndividual,
+        x_train=np.zeros(1),
+        y_train=np.zeros(1),
+        size=size,
+        seed=seed,
+        maximize=maximize,
+        additional_parameters=params or {"nodes": (5,)},
+        mutation_rate=0.05,
+    )
+
+
+def test_population_random_init_deterministic():
+    p1 = make_population(seed=3)
+    p2 = make_population(seed=3)
+    assert [i.get_genes() for i in p1] == [i.get_genes() for i in p2]
+    p3 = make_population(seed=4)
+    assert [i.get_genes() for i in p1] != [i.get_genes() for i in p3]
+
+
+def test_get_fittest_maximize_and_minimize():
+    pop = make_population()
+    best = pop.get_fittest()
+    assert best.get_fitness() == max(pop.get_fitnesses())
+    pop_min = make_population(maximize=False)
+    worst = pop_min.get_fittest()
+    assert worst.get_fitness() == min(pop_min.get_fitnesses())
+
+
+def test_ga_improves_onemax():
+    pop = make_population(size=16, seed=0, **{"nodes": (6,)})
+    ga = GeneticAlgorithm(pop, tournament_size=3, seed=0)
+    initial_best = pop.get_fittest().get_fitness()
+    best = ga.run(12)
+    assert best.get_fitness() >= initial_best
+    assert best.get_fitness() >= 12  # 15 bits total for nodes=(6,); near-optimal expected
+
+
+def test_ga_run_is_reproducible():
+    best1 = GeneticAlgorithm(make_population(seed=5), seed=9).run(4)
+    best2 = GeneticAlgorithm(make_population(seed=5), seed=9).run(4)
+    assert best1.get_genes() == best2.get_genes()
+    assert best1.get_fitness() == best2.get_fitness()
+
+
+def test_elitism_keeps_best_without_retraining():
+    pop = make_population(size=8, seed=2)
+    ga = GeneticAlgorithm(pop, elitism=True, seed=2)
+    best_before = pop.get_fittest().get_fitness()
+    ga.evolve_population()
+    elite = ga.population[0]
+    assert elite.fitness_evaluated  # cached through copy — no retrain
+    assert elite.get_fitness() == best_before
+
+
+def test_russian_roulette_selection_prefers_fit(monkeypatch):
+    pop = make_population(size=10, seed=7)
+    ga = RussianRouletteGA(pop, seed=7)
+    pop.evaluate()
+    weights = ga._selection_weights()
+    fits = np.array(pop.get_fitnesses())
+    assert weights[np.argmax(fits)] >= weights[np.argmin(fits)]
+    assert np.isclose(weights.sum(), 1.0)
+    # degenerate case: all-equal fitness → uniform
+    for ind in pop:
+        ind.set_fitness(3.0)
+    assert np.allclose(ga._selection_weights(), 0.1)
+
+
+def test_russian_roulette_improves_onemax():
+    pop = make_population(size=16, seed=11, **{"nodes": (6,)})
+    ga = RussianRouletteGA(pop, seed=11)
+    best = ga.run(12)
+    assert best.get_fitness() >= 11
+
+
+def test_generation_history_records_metric():
+    ga = GeneticAlgorithm(make_population(size=6, seed=1), seed=1)
+    ga.run(2)
+    assert len(ga.history) == 2
+    rec = ga.history[0]
+    assert {"generation", "best_fitness", "individuals_per_hour_per_chip"} <= set(rec)
+
+
+def test_grid_population_enumerates_product():
+    pop = GridPopulation(
+        OneMaxIndividual,
+        x_train=np.zeros(1),
+        y_train=np.zeros(1),
+        genes_grid={"S_1": [(0, 0, 0), (1, 1, 1)]},
+        additional_parameters={"nodes": (3,)},
+        seed=0,
+    )
+    assert len(pop) == 2
+    assert sorted(p.get_fitness() for p in pop) == [0.0, 3.0]
+
+
+def test_grid_population_rejects_unknown_gene():
+    with pytest.raises(ValueError):
+        GridPopulation(
+            OneMaxIndividual,
+            genes_grid={"bogus": [1]},
+            additional_parameters={"nodes": (3,)},
+            seed=0,
+        )
+
+
+def test_state_dict_restores_config_across_mismatched_population():
+    """Resuming must honor the checkpoint's genome spec + rates, not the
+    receiving population's construction-time config."""
+    ga = GeneticAlgorithm(make_population(size=6, seed=1, **{"nodes": (6,)}), seed=1)
+    ga.evolve_population()
+    state = ga.state_dict()
+
+    other = make_population(size=6, seed=9, **{"nodes": (3,)})  # wrong spec on purpose
+    other.mutation_rate = 0.9
+    ga2 = GeneticAlgorithm(other, seed=9)
+    ga2.load_state_dict(state)
+    assert ga2.population.additional_parameters == {"nodes": (6,)}
+    assert ga2.population.mutation_rate == ga.population.mutation_rate
+    assert [i.get_genes() for i in ga2.population] == [i.get_genes() for i in ga.population]
+    assert all(i.mutation_rate == ga.population.mutation_rate for i in ga2.population)
+
+
+def test_state_dict_round_trip():
+    pop = make_population(size=6, seed=1)
+    ga = GeneticAlgorithm(pop, seed=1)
+    ga.evolve_population()
+    state = ga.state_dict()
+
+    pop2 = make_population(size=6, seed=99)
+    ga2 = GeneticAlgorithm(pop2, seed=99)
+    ga2.load_state_dict(state)
+    assert ga2.generation == ga.generation
+    assert [i.get_genes() for i in ga2.population] == [i.get_genes() for i in ga.population]
+    # resumed run must continue identically
+    b1 = ga.run(3)
+    b2 = ga2.run(3)
+    assert b1.get_genes() == b2.get_genes()

@@ -1,0 +1,135 @@
+"""Genome spec + operator tests (SURVEY.md §4: operator determinism, bounds)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gentun_tpu.genes import (
+    BinaryGene,
+    ChoiceGene,
+    FloatGene,
+    GenomeSpec,
+    IntGene,
+    boosting_genome,
+    genetic_cnn_genome,
+    xgboost_genome,
+)
+
+
+def test_genetic_cnn_genome_shapes():
+    spec = genetic_cnn_genome((3, 5))
+    assert spec.names == ["S_1", "S_2"]
+    assert spec["S_1"].length == 3
+    assert spec["S_2"].length == 10
+
+
+def test_sample_is_deterministic_under_seed():
+    spec = genetic_cnn_genome((3, 5))
+    a = spec.sample(np.random.default_rng(7))
+    b = spec.sample(np.random.default_rng(7))
+    assert a == b
+    c = spec.sample(np.random.default_rng(8))
+    assert a != c  # overwhelmingly likely for 13 bits
+
+
+def test_sample_within_bounds(rng):
+    spec = boosting_genome()
+    for _ in range(50):
+        value = spec.validate(spec.sample(rng))  # validate() raises if out of bounds
+        assert set(value) == set(spec.names)
+
+
+def test_crossover_gene_granularity(rng):
+    spec = genetic_cnn_genome((3, 5))
+    a = {"S_1": (0, 0, 0), "S_2": (0,) * 10}
+    b = {"S_1": (1, 1, 1), "S_2": (1,) * 10}
+    for _ in range(20):
+        child = spec.crossover(a, b, rng)
+        # whole-gene inheritance: never a mixed bit-string (SURVEY §2.3)
+        assert child["S_1"] in (a["S_1"], b["S_1"])
+        assert child["S_2"] in (a["S_2"], b["S_2"])
+
+
+def test_crossover_rate_extremes(rng):
+    spec = genetic_cnn_genome((3,))
+    a, b = {"S_1": (0, 0, 0)}, {"S_1": (1, 1, 1)}
+    assert spec.crossover(a, b, rng, rate=0.0) == a
+    assert spec.crossover(a, b, rng, rate=1.0) == b
+
+
+def test_mutation_rate_zero_is_identity(rng):
+    spec = xgboost_genome()
+    value = spec.sample(rng)
+    assert spec.mutate(value, rng, rate=0.0) == value
+
+
+def test_mutation_rate_one_flips_all_bits(rng):
+    gene = BinaryGene("g", 16)
+    value = gene.sample(rng)
+    flipped = gene.mutate(value, rng, rate=1.0)
+    assert all(x != y for x, y in zip(value, flipped))
+
+
+def test_binary_mutation_rate_statistics():
+    gene = BinaryGene("g", 1000)
+    rng = np.random.default_rng(0)
+    value = (0,) * 1000
+    flips = sum(sum(gene.mutate(value, rng, rate=0.015)) for _ in range(20))
+    # 20 * 1000 * 0.015 = 300 expected flips; loose 3-sigma-ish bounds
+    assert 200 < flips < 420
+
+
+def test_float_gene_log_scale(rng):
+    gene = FloatGene("lr", 0.01, 1e-4, 1.0, log_scale=True)
+    samples = [gene.sample(rng) for _ in range(200)]
+    assert all(1e-4 <= s <= 1.0 for s in samples)
+    # log-uniform: ~half the samples land below the geometric midpoint 1e-2
+    below = sum(s < 1e-2 for s in samples)
+    assert 60 < below < 140
+
+
+def test_validation_rejects_bad_values():
+    spec = genetic_cnn_genome((3,))
+    with pytest.raises(ValueError):
+        spec.validate({"S_1": (0, 1)})  # wrong length
+    with pytest.raises(ValueError):
+        spec.validate({"S_1": (0, 1, 2)})  # non-binary
+    with pytest.raises(ValueError):
+        spec.validate({})  # missing
+    with pytest.raises(ValueError):
+        spec.validate({"S_1": (0, 1, 0), "bogus": 1})  # unknown
+
+    gene = IntGene("d", 5, 1, 10)
+    with pytest.raises(ValueError):
+        gene.validate(11)
+    choice = ChoiceGene("c", "a", ("a", "b"))
+    with pytest.raises(ValueError):
+        choice.validate("z")
+
+
+def test_genome_json_round_trip(rng):
+    """Genes must survive the wire format (SURVEY.md §5 config schema)."""
+    for spec in (genetic_cnn_genome((3, 5)), boosting_genome()):
+        value = spec.sample(rng)
+        revived = spec.validate(json.loads(json.dumps(value)))
+        assert revived == value
+
+
+def test_grid_enumeration():
+    spec = GenomeSpec([IntGene("a", 1, 1, 3), ChoiceGene("b", "x", ("x", "y"))])
+    grid = spec.grid(grid_sizes={"a": 3})
+    assert len(grid) == 6
+    assert {tuple(sorted(g.items())) for g in grid} == {
+        (("a", i), ("b", c)) for i in (1, 2, 3) for c in ("x", "y")
+    }
+
+
+def test_binary_grid_values():
+    gene = BinaryGene("g", 3)
+    assert len(gene.grid_values()) == 8
+
+
+def test_duplicate_gene_names_rejected():
+    with pytest.raises(ValueError):
+        GenomeSpec([IntGene("a", 1, 0, 2), IntGene("a", 1, 0, 2)])

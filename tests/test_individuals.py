@@ -1,0 +1,98 @@
+"""Individual tests: lazy fitness caching, reproduce semantics (SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+from gentun_tpu.genes import GenomeSpec, IntGene, genetic_cnn_genome
+from gentun_tpu.individuals import GeneticCnnIndividual, Individual
+
+
+class CountingIndividual(Individual):
+    """Fitness = sum of gene bits; counts evaluations to prove caching."""
+
+    eval_count = 0
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (3,))))
+
+    def evaluate(self):
+        type(self).eval_count += 1
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+@pytest.fixture(autouse=True)
+def reset_counter():
+    CountingIndividual.eval_count = 0
+
+
+def make(genes=None, **kw):
+    return CountingIndividual(x_train=np.zeros(1), y_train=np.zeros(1), genes=genes,
+                              rng=np.random.default_rng(0), **kw)
+
+
+def test_fitness_is_lazy_and_cached():
+    ind = make()
+    assert CountingIndividual.eval_count == 0
+    f1 = ind.get_fitness()
+    f2 = ind.get_fitness()
+    assert f1 == f2
+    assert CountingIndividual.eval_count == 1
+
+
+def test_mutation_resets_fitness_only_on_change():
+    ind = make(genes={"S_1": (1, 0, 1)})
+    ind.get_fitness()
+    ind.mutation_rate = 0.0
+    ind.mutate()
+    assert ind.fitness_evaluated  # no-op mutation keeps the cache
+    ind.mutation_rate = 1.0
+    ind.mutate()
+    assert not ind.fitness_evaluated
+    assert ind.get_fitness() == 1.0  # (0,1,0)
+    assert CountingIndividual.eval_count == 2
+
+
+def test_reproduce_child_is_unevaluated():
+    a, b = make(), make()
+    a.get_fitness(), b.get_fitness()
+    child = a.reproduce(b)
+    assert not child.fitness_evaluated
+    assert child is not a and child is not b
+
+
+def test_copy_preserves_cached_fitness_for_same_genes():
+    ind = make()
+    ind.get_fitness()
+    clone = ind.copy()
+    assert clone.fitness_evaluated  # elites don't retrain (SURVEY §2.3)
+    clone2 = ind.copy(genes={"S_1": tuple(1 - b for b in ind.genes["S_1"])})
+    assert not clone2.fitness_evaluated
+
+
+def test_set_fitness_external():
+    """Distributed master writes worker replies via set_fitness (SURVEY §3.2)."""
+    ind = CountingIndividual(genes={"S_1": (0, 0, 0)}, rng=np.random.default_rng(0))
+    ind.set_fitness(0.75)
+    assert ind.get_fitness() == 0.75
+    assert CountingIndividual.eval_count == 0
+
+
+def test_missing_data_raises():
+    ind = GeneticCnnIndividual(genes={"S_1": (0, 0, 0), "S_2": (0,) * 10},
+                               rng=np.random.default_rng(0))
+    with pytest.raises(RuntimeError):
+        ind.get_fitness()
+
+
+def test_extra_kwargs_fold_into_additional_parameters():
+    ind = CountingIndividual(rng=np.random.default_rng(0), nodes=(3,), kfold=3)
+    assert ind.additional_parameters["kfold"] == 3
+    assert ind.spec.names == ["S_1"]
+
+
+def test_crossover_uses_parent_rates():
+    a = make(genes={"S_1": (0, 0, 0)})
+    b = make(genes={"S_1": (1, 1, 1)})
+    a.crossover_rate = 0.0
+    child = a.crossover(b)
+    assert child.genes == a.genes
