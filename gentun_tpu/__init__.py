@@ -61,14 +61,14 @@ try:  # pragma: no cover - exercised implicitly
     from .models.cnn import GeneticCnnModel  # noqa: F401
 
     __all__.append("GeneticCnnModel")
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     pass
 
 try:  # pragma: no cover
     from .models.boosting import BoostingModel  # noqa: F401
 
     __all__.append("BoostingModel")
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     pass
 
 try:  # pragma: no cover
@@ -76,5 +76,5 @@ try:  # pragma: no cover
     from .distributed.client import GentunClient  # noqa: F401
 
     __all__ += ["DistributedPopulation", "GentunClient"]
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     pass

@@ -223,15 +223,27 @@ class RussianRouletteGA(GeneticAlgorithm):
     """
 
     def _selection_weights(self) -> np.ndarray:
-        fits = np.asarray(self.population.get_fitnesses(), dtype=np.float64)
+        # Fitnesses are fixed during the reproduction loop, so the weight
+        # vector is reused across the ~2N parent draws of a generation; the
+        # cache keys on the fitness values so in-place set_fitness() (e.g.
+        # from the distributed master) invalidates it.
+        fit_list = self.population.get_fitnesses()
+        key = (id(self.population), tuple(fit_list))
+        cached = getattr(self, "_weights_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        fits = np.asarray(fit_list, dtype=np.float64)
         if not self.population.maximize:
             fits = -fits
         # Shift so the worst member still has a small non-zero chance.
         lo, hi = fits.min(), fits.max()
         if hi == lo:
-            return np.full(len(fits), 1.0 / len(fits))
-        shifted = fits - lo + 0.1 * (hi - lo)
-        return shifted / shifted.sum()
+            weights = np.full(len(fits), 1.0 / len(fits))
+        else:
+            shifted = fits - lo + 0.1 * (hi - lo)
+            weights = shifted / shifted.sum()
+        self._weights_cache = (key, weights)
+        return weights
 
     def select_parent(self) -> Individual:
         weights = self._selection_weights()

@@ -276,16 +276,29 @@ class GenomeSpec:
             raise ValueError(f"genome has unknown genes: {extra}")
         return {g.name: g.validate(value[g.name]) for g in self._genes}
 
-    def grid(self, grid_sizes: Mapping[str, int] | None = None) -> List[Dict[str, Any]]:
+    def grid(
+        self,
+        grid_sizes: Mapping[str, int] | None = None,
+        gene_values: Mapping[str, Sequence[Any]] | None = None,
+    ) -> List[Dict[str, Any]]:
         """Cartesian product of per-gene value grids (``GridPopulation`` init).
 
         Mirrors gentun's grid-of-gene-values initialisation
-        (``gentun/populations.py`` [PUB]; SURVEY.md §2.0 row 4).
+        (``gentun/populations.py`` [PUB]; SURVEY.md §2.0 row 4).  Per-gene
+        axes come from, in priority order: an explicit value list in
+        ``gene_values``, a point count in ``grid_sizes`` (numeric genes), or
+        the gene's full ``grid_values()``.
         """
         grid_sizes = dict(grid_sizes or {})
+        gene_values = dict(gene_values or {})
+        unknown = [k for k in gene_values if k not in self._by_name]
+        if unknown:
+            raise ValueError(f"gene_values has unknown genes: {unknown}")
         axes: List[List[Any]] = []
         for g in self._genes:
-            if isinstance(g, (FloatGene, IntGene)) and g.name in grid_sizes:
+            if g.name in gene_values:
+                axes.append([g.validate(v) for v in gene_values[g.name]])
+            elif isinstance(g, (FloatGene, IntGene)) and g.name in grid_sizes:
                 axes.append(g.grid_values(grid_sizes[g.name]))
             else:
                 axes.append(g.grid_values())

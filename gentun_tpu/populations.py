@@ -141,8 +141,12 @@ class Population:
         batch_fn = getattr(model_cls, "cross_validate_population", None)
         if batch_fn is None:
             return False
-        # Batched evaluation requires one shared config across the population;
-        # additional_parameters is population-level here, so that holds.
+        # Batched evaluation requires one shared config across the population.
+        # Individuals added via add_individual() can carry divergent
+        # additional_parameters (e.g. different stage sizes); those must take
+        # the sequential path or they'd be decoded under the wrong config.
+        if any(ind.additional_parameters != self.additional_parameters for ind in pending):
+            return False
         genomes = [ind.get_genes() for ind in pending]
         fitnesses = batch_fn(self.x_train, self.y_train, genomes, **self.additional_parameters)
         for ind, fit in zip(pending, fitnesses):
@@ -198,16 +202,5 @@ class GridPopulation(Population):
         )
         # Need a spec to enumerate the grid; build a throwaway individual.
         probe = self.spawn()
-        spec = probe.spec
-        genes_grid = dict(genes_grid or {})
-        unknown = [k for k in genes_grid if k not in spec]
-        if unknown:
-            raise ValueError(f"genes_grid has unknown genes: {unknown}")
-        axes: Dict[str, Sequence[Any]] = {}
-        for gene in spec.genes:
-            axes[gene.name] = list(genes_grid.get(gene.name, gene.grid_values()))
-        import itertools
-
-        names = list(axes)
-        for combo in itertools.product(*(axes[n] for n in names)):
-            self.add_individual(self.spawn(genes=dict(zip(names, combo))))
+        for genome in probe.spec.grid(gene_values=genes_grid):
+            self.add_individual(self.spawn(genes=genome))

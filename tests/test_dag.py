@@ -1,0 +1,177 @@
+"""Tests for the Genetic-CNN DAG decode (ops/dag.py).
+
+SURVEY.md §7 step 2 calls for exhaustive decode checks at small stage sizes:
+for k=3 there are 2**3 = 8 graphs, enumerable by hand.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from gentun_tpu.ops.dag import (
+    StageMasks,
+    adjacency_to_bits,
+    bits_to_adjacency,
+    canonical_key,
+    decode_genome,
+    decode_stage,
+    stack_genome_masks,
+    triangular_index,
+)
+
+
+class TestTriangularIndex:
+    def test_ordering_matches_paper_grouping(self):
+        # Bits grouped by target: (0→1), (0→2), (1→2), (0→3), ...
+        assert triangular_index(0, 1) == 0
+        assert triangular_index(0, 2) == 1
+        assert triangular_index(1, 2) == 2
+        assert triangular_index(0, 3) == 3
+        assert triangular_index(2, 3) == 5
+
+    def test_rejects_bad_pairs(self):
+        with pytest.raises(ValueError):
+            triangular_index(2, 2)
+        with pytest.raises(ValueError):
+            triangular_index(3, 1)
+
+    def test_bijection_with_adjacency(self):
+        k = 5
+        n_bits = k * (k - 1) // 2
+        bits = tuple(int(b) for b in np.random.default_rng(0).integers(0, 2, n_bits))
+        adj = bits_to_adjacency(bits, k)
+        for i in range(k):
+            for j in range(i + 1, k):
+                assert adj[i, j] == bits[triangular_index(i, j)]
+        assert adjacency_to_bits(adj) == bits
+
+
+class TestDecodeStageExhaustiveK3:
+    """All 8 graphs for k=3, checked against hand-derived expectations."""
+
+    # bits = (b_01, b_02, b_12) → expected (active, entry, exit)
+    CASES = {
+        (0, 0, 0): ([0, 0, 0], [0, 0, 0], [0, 0, 0]),  # all isolated: identity stage
+        (1, 0, 0): ([1, 1, 0], [1, 0, 0], [0, 1, 0]),  # chain 0→1, node 2 isolated
+        (0, 1, 0): ([1, 0, 1], [1, 0, 0], [0, 0, 1]),  # chain 0→2
+        (0, 0, 1): ([0, 1, 1], [0, 1, 0], [0, 0, 1]),  # chain 1→2
+        (1, 1, 0): ([1, 1, 1], [1, 0, 0], [0, 1, 1]),  # fan-out 0→{1,2}
+        (1, 0, 1): ([1, 1, 1], [1, 0, 0], [0, 0, 1]),  # path 0→1→2
+        (0, 1, 1): ([1, 1, 1], [1, 1, 0], [0, 0, 1]),  # fan-in {0,1}→2
+        (1, 1, 1): ([1, 1, 1], [1, 0, 0], [0, 0, 1]),  # full DAG
+    }
+
+    @pytest.mark.parametrize("bits", list(CASES))
+    def test_masks(self, bits):
+        active, entry, exit_ = self.CASES[bits]
+        m = decode_stage(bits, 3)
+        np.testing.assert_array_equal(m.active, np.float32(active))
+        np.testing.assert_array_equal(m.entry, np.float32(entry))
+        np.testing.assert_array_equal(m.exit, np.float32(exit_))
+        assert m.has_active == (1.0 if any(active) else 0.0)
+
+    def test_all_zero_is_identity_stage(self):
+        m = decode_stage((0, 0, 0), 3)
+        assert m.has_active == 0.0
+        assert m.adj.sum() == 0
+
+
+class TestDecodeInvariants:
+    """Property checks over every k=4 and random k=5 bit-strings."""
+
+    @pytest.mark.parametrize("k", [2, 3, 4])
+    def test_exhaustive_invariants(self, k):
+        n_bits = k * (k - 1) // 2
+        for bits in itertools.product((0, 1), repeat=n_bits):
+            self._check(decode_stage(bits, k), bits)
+
+    def test_random_k5(self):
+        rng = np.random.default_rng(7)
+        for _ in range(200):
+            bits = tuple(int(b) for b in rng.integers(0, 2, 10))
+            self._check(decode_stage(bits, 5), bits)
+
+    @staticmethod
+    def _check(m: StageMasks, bits):
+        k = m.k
+        # adjacency strictly upper triangular, equals input bits
+        assert np.all(np.tril(m.adj) == 0)
+        assert adjacency_to_bits(m.adj) == tuple(bits)
+        in_deg = m.adj.sum(axis=0)
+        out_deg = m.adj.sum(axis=1)
+        # every node with any edge is active; isolated nodes inactive
+        np.testing.assert_array_equal(m.active, ((in_deg + out_deg) > 0).astype(np.float32))
+        # entry/exit only on active nodes
+        assert np.all(m.entry <= m.active)
+        assert np.all(m.exit <= m.active)
+        # active ⇒ reachable: every active non-entry node has an in-edge
+        np.testing.assert_array_equal(m.entry, m.active * (in_deg == 0))
+        np.testing.assert_array_equal(m.exit, m.active * (out_deg == 0))
+        # at least one entry and one exit whenever anything is active
+        if m.has_active:
+            assert m.entry.sum() >= 1 and m.exit.sum() >= 1
+        else:
+            assert m.active.sum() == 0
+
+
+class TestGenomeDecode:
+    def test_decode_genome_and_stack(self):
+        nodes = (3, 5)
+        genomes = [
+            {"S_1": (1, 0, 1), "S_2": tuple(int(b) for b in np.random.default_rng(i).integers(0, 2, 10))}
+            for i in range(4)
+        ]
+        masks = decode_genome(genomes[0], nodes)
+        assert [m.k for m in masks] == [3, 5]
+
+        stacked = stack_genome_masks(genomes, nodes)
+        assert len(stacked) == 2
+        assert stacked[0]["adj"].shape == (4, 3, 3)
+        assert stacked[1]["adj"].shape == (4, 5, 5)
+        assert stacked[0]["entry"].shape == (4, 3)
+        assert stacked[1]["has_active"].shape == (4,)
+        # stacking preserves per-genome decode
+        for p, g in enumerate(genomes):
+            per = decode_genome(g, nodes)
+            for s in range(2):
+                np.testing.assert_array_equal(stacked[s]["adj"][p], per[s].adj)
+
+    def test_missing_gene_raises(self):
+        with pytest.raises(KeyError):
+            decode_genome({"S_1": (0, 0, 0)}, (3, 5))
+
+
+class TestCanonicalKey:
+    def test_isomorphic_chains_collapse(self):
+        # For k=3: single-edge graphs 0→1, 0→2, 1→2 are all "a 2-chain plus
+        # an isolated node" — architecturally identical.
+        keys = {
+            canonical_key({"S_1": bits}, (3,))
+            for bits in [(1, 0, 0), (0, 1, 0), (0, 0, 1)]
+        }
+        assert len(keys) == 1
+
+    def test_distinct_architectures_stay_distinct(self):
+        k_chain = canonical_key({"S_1": (1, 0, 1)}, (3,))  # path 0→1→2
+        k_fanin = canonical_key({"S_1": (0, 1, 1)}, (3,))  # {0,1}→2
+        k_fanout = canonical_key({"S_1": (1, 1, 0)}, (3,))  # 0→{1,2}
+        k_empty = canonical_key({"S_1": (0, 0, 0)}, (3,))
+        assert len({k_chain, k_fanin, k_fanout, k_empty}) == 4
+
+    def test_canonicalization_is_idempotent_and_valid(self):
+        rng = np.random.default_rng(3)
+        for _ in range(100):
+            bits = tuple(int(b) for b in rng.integers(0, 2, 10))
+            key = canonical_key({"S_1": bits}, (5,))
+            # canonical bits are themselves a valid genome mapping to itself
+            assert canonical_key({"S_1": key[0]}, (5,)) == key
+
+    def test_equivalence_classes_k3_total(self):
+        # The 8 k=3 graphs collapse into exactly 6 architecture classes:
+        # empty, 2-chain(x3 isomorphs), 3-path, fan-in, fan-out, full DAG.
+        keys = {
+            canonical_key({"S_1": bits}, (3,))
+            for bits in itertools.product((0, 1), repeat=3)
+        }
+        assert len(keys) == 6
