@@ -225,10 +225,10 @@ class RussianRouletteGA(GeneticAlgorithm):
     def _selection_weights(self) -> np.ndarray:
         # Fitnesses are fixed during the reproduction loop, so the weight
         # vector is reused across the ~2N parent draws of a generation; the
-        # cache keys on the fitness values so in-place set_fitness() (e.g.
-        # from the distributed master) invalidates it.
+        # weights are a pure function of the fitness values, so those alone
+        # key the cache (in-place set_fitness() changes them and invalidates).
         fit_list = self.population.get_fitnesses()
-        key = (id(self.population), tuple(fit_list))
+        key = tuple(fit_list)
         cached = getattr(self, "_weights_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]

@@ -1,0 +1,24 @@
+"""Fitness models: the compute layer (SURVEY.md §2.0 rows 8-9).
+
+``GentunModel`` is the ABC; ``GeneticCnnModel`` is the TPU hot path;
+``BoostingModel`` is the non-TPU control path (sklearn gradient boosting —
+xgboost is absent from this environment, SURVEY.md §2.1).
+"""
+
+from .generic import GentunModel
+
+__all__ = ["GentunModel"]
+
+try:  # jax/flax may be absent in minimal installs
+    from .cnn import GeneticCnnModel, MaskedGeneticCnn  # noqa: F401
+
+    __all__ += ["GeneticCnnModel", "MaskedGeneticCnn"]
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from .boosting import BoostingModel  # noqa: F401
+
+    __all__ += ["BoostingModel"]
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,444 @@
+"""Genetic-CNN fitness model: a masked supergraph trained under one XLA program.
+
+Reference parity: ``GeneticCnnModel`` in ``gentun/models/keras_models.py``
+[PUB] (SURVEY.md §2.0 row 9, §3.4).  Behaviors preserved:
+
+- decode binary genes → per-stage DAG of Conv(3×3)+ReLU nodes, sum-merge
+  fan-in, default input/output nodes, isolated nodes dropped;
+- max-pool 2×2 between stages; dense head with dropout;
+- SGD with a staged learning-rate schedule given as parallel tuples, e.g.
+  ``epochs=(20, 4, 1)``, ``learning_rate=(1e-2, 1e-3, 1e-4)``;
+- k-fold cross-validation; fitness = mean validation accuracy.
+
+TPU-first architecture (NOT how the reference does it — SURVEY.md §7
+"hard parts" #1):
+
+- **One compiled program for the whole search space.**  The reference builds
+  a fresh Keras graph per genome; a naive port would pay an XLA compile per
+  individual, which on an 8k-architecture search space can dwarf train time.
+  Here the network is a *supergraph* over all ``K_s`` nodes per stage, and a
+  genome enters as mask **arrays** (``ops/dag.py``) — data, not structure.
+  Every genome shares one jitted train function.
+- **Whole populations train as one batched program.**  ``vmap`` over the
+  (params, masks) population axis turns N independent CNN trainings into a
+  single XLA computation whose matmuls are N-times wider — exactly what the
+  MXU wants.  This is `cross_validate_population`, the hook
+  ``Population.evaluate`` uses.
+- **bfloat16 compute, float32 params/logits** by default on TPU: conv math
+  rides the MXU at double rate while SGD accumulates in float32.
+- Static shapes everywhere: fold sizes are equalised by trimming, train
+  batches are a precomputed ``(steps, batch)`` index array consumed by
+  ``lax.scan``, eval uses padded index batches with 0/1 weights.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+
+from ..ops.dag import stack_genome_masks
+from .generic import GentunModel
+
+__all__ = ["MaskedGeneticCnn", "GeneticCnnModel"]
+
+
+class MaskedGeneticCnn(nn.Module):
+    """The stage-DAG supergraph as a Flax module.
+
+    ``masks`` is a list (one entry per stage) of dicts with keys
+    ``adj (k, k)``, ``active (k,)``, ``entry (k,)``, ``exit (k,)``,
+    ``has_active ()`` — see :func:`gentun_tpu.ops.dag.decode_stage`.  All
+    mask values participate only multiplicatively, so the module traces to
+    the same XLA program for every genome and is freely ``vmap``-able over a
+    leading population axis on the masks.
+
+    Stage recipe (reference recipe is [UNCERTAIN] per SURVEY.md §3.4; this
+    is the documented rebuild choice): entry Conv3×3(F_s)+ReLU produces the
+    default input node; each supergraph node is Conv3×3(F_s)+ReLU over the
+    masked sum of its predecessors (+ stage input for entry nodes); the
+    default output node sums exit-node outputs (identity pass-through when
+    the stage decodes empty); 2×2 max-pool closes the stage.  Head:
+    Dense(dense_units)+ReLU → Dropout → Dense(n_classes), logits in float32.
+    """
+
+    nodes: Tuple[int, ...]
+    filters: Tuple[int, ...]
+    dense_units: int = 500
+    n_classes: int = 10
+    dropout_rate: float = 0.5
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, masks, train: bool = False):
+        dtype = self.compute_dtype
+        x = x.astype(dtype)
+        for s, k in enumerate(self.nodes):
+            m = masks[s]
+            f = self.filters[s]
+            conv = functools.partial(
+                nn.Conv, features=f, kernel_size=(3, 3), padding="SAME", dtype=dtype
+            )
+            a0 = nn.relu(conv(name=f"stage{s}_entry")(x))
+            adj = m["adj"].astype(dtype)
+            entry = m["entry"].astype(dtype)
+            active = m["active"].astype(dtype)
+            exit_ = m["exit"].astype(dtype)
+            has_active = m["has_active"].astype(dtype)
+            outs: List[jax.Array] = []
+            for j in range(k):
+                inp = entry[j] * a0
+                for i in range(j):
+                    inp = inp + adj[i, j] * outs[i]
+                h = nn.relu(conv(name=f"stage{s}_node{j}")(inp))
+                # Zero inactive nodes so they cannot leak into any sum.
+                outs.append(active[j] * h)
+            if k:
+                out = outs[0] * exit_[0]
+                for i in range(1, k):
+                    out = out + exit_[i] * outs[i]
+                x = has_active * out + (1.0 - has_active) * a0
+            else:
+                x = a0
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_units, dtype=dtype)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # Final projection + logits in float32: cheap, and keeps the
+        # softmax/cross-entropy numerics out of bfloat16.
+        x = nn.Dense(self.n_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Compiled population-training factory
+# ---------------------------------------------------------------------------
+#
+# Everything static (architecture config, schedule, step counts) is baked
+# into the factory key; everything genome- or data-dependent flows in as
+# arrays.  The lru_cache means a whole GA search — hundreds of evaluations —
+# compiles exactly once per (config, fold-shape) pair.
+
+
+@functools.lru_cache(maxsize=32)
+def _population_cv_fn(
+    nodes: Tuple[int, ...],
+    filters: Tuple[int, ...],
+    dense_units: int,
+    n_classes: int,
+    dropout_rate: float,
+    compute_dtype: str,
+    epochs: Tuple[int, ...],
+    learning_rate: Tuple[float, ...],
+    momentum: float,
+    nesterov: bool,
+    batch_size: int,
+    n_train: int,
+    n_val_padded: int,
+):
+    model = MaskedGeneticCnn(
+        nodes=nodes,
+        filters=filters,
+        dense_units=dense_units,
+        n_classes=n_classes,
+        dropout_rate=dropout_rate,
+        compute_dtype=jnp.dtype(compute_dtype),
+    )
+    steps_per_epoch = n_train // batch_size
+    if steps_per_epoch == 0:
+        raise ValueError(f"batch_size {batch_size} exceeds fold train size {n_train}")
+    # Staged LR: boundaries at epoch-group ends, in units of optimizer steps
+    # (gentun's parallel (epochs, learning_rate) tuples — SURVEY.md §3.4).
+    boundaries_and_scales = {}
+    step_mark = 0
+    for n_ep, lr_prev, lr_next in zip(epochs[:-1], learning_rate[:-1], learning_rate[1:]):
+        step_mark += n_ep * steps_per_epoch
+        # A zero-epoch group lands two transitions on one step; their scales
+        # must compound rather than overwrite.
+        boundaries_and_scales[step_mark] = (
+            boundaries_and_scales.get(step_mark, 1.0) * lr_next / lr_prev
+        )
+    schedule = optax.piecewise_constant_schedule(learning_rate[0], boundaries_and_scales)
+    tx = optax.sgd(schedule, momentum=momentum, nesterov=nesterov)
+
+    def loss_fn(params, masks, batch_x, batch_y, dropout_rng):
+        logits = model.apply(
+            {"params": params}, batch_x, masks, train=True, rngs={"dropout": dropout_rng}
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(logits, batch_y).mean()
+
+    def train_one(params, masks, x_tr, y_tr, x_val, y_val, val_weight, batch_idx, rng):
+        """Full train + eval for ONE individual (vmapped below)."""
+        opt_state = tx.init(params)
+
+        def step(carry, idx_b):
+            params, opt_state, rng = carry
+            rng, dropout_rng = jax.random.split(rng)
+            batch_x = jnp.take(x_tr, idx_b, axis=0)
+            batch_y = jnp.take(y_tr, idx_b, axis=0)
+            loss, grads = jax.value_and_grad(loss_fn)(params, masks, batch_x, batch_y, dropout_rng)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, rng), loss
+
+        (params, _, _), losses = jax.lax.scan(step, (params, opt_state, rng), batch_idx)
+
+        def eval_batch(correct, start):
+            xb = jax.lax.dynamic_slice_in_dim(x_val, start, batch_size, axis=0)
+            yb = jax.lax.dynamic_slice_in_dim(y_val, start, batch_size, axis=0)
+            wb = jax.lax.dynamic_slice_in_dim(val_weight, start, batch_size, axis=0)
+            logits = model.apply({"params": params}, xb, masks, train=False)
+            hits = (jnp.argmax(logits, axis=-1) == yb).astype(jnp.float32)
+            return correct + jnp.sum(hits * wb), None
+
+        starts = jnp.arange(0, n_val_padded, batch_size)
+        correct, _ = jax.lax.scan(eval_batch, jnp.float32(0.0), starts)
+        acc = correct / jnp.maximum(val_weight.sum(), 1.0)
+        return acc, losses[-1]
+
+    # Population axis: params, masks, rng are per-individual; data is shared.
+    vmapped = jax.vmap(train_one, in_axes=(0, 0, None, None, None, None, None, None, 0))
+    return jax.jit(vmapped)
+
+
+def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, seed):
+    """Per-individual parameter init (vmapped so shapes carry a P axis)."""
+    keys = jax.random.split(jax.random.PRNGKey(seed), pop_size)
+    dummy = jnp.zeros((1, *input_shape), dtype=jnp.float32)
+
+    def init_one(key, masks):
+        return model.init({"params": key}, dummy, masks, train=False)["params"]
+
+    return jax.vmap(init_one, in_axes=(0, 0))(keys, masks_stacked)
+
+
+class GeneticCnnModel(GentunModel):
+    """Train the decoded CNN under k-fold CV; fitness = mean val accuracy.
+
+    Drop-in counterpart of the reference's ``GeneticCnnModel``
+    (``gentun/models/keras_models.py`` [PUB]).  Config knobs mirror the
+    reference's constructor (SURVEY.md §3.4), all optional:
+
+    - ``nodes=(3, 5)``: stage node counts (must match the genome).
+    - ``kernels_per_layer=(20, 50)``: per-stage conv channels.
+    - ``input_shape``: HWC; inferred from ``x_train`` when omitted (flat
+      inputs are reshaped to it).
+    - ``kfold=5``; ``epochs=(20, 4, 1)``; ``learning_rate=(1e-2, 1e-3, 1e-4)``;
+      ``batch_size=128``; ``dense_units=500``; ``dropout_rate=0.5``;
+      ``n_classes`` (inferred); ``momentum=0.9``; ``nesterov=False``;
+      ``compute_dtype='bfloat16'``; ``seed=0``.
+    """
+
+    def __init__(
+        self,
+        x_train,
+        y_train,
+        genes: Mapping[str, Any],
+        nodes: Sequence[int] = (3, 5),
+        input_shape: Optional[Sequence[int]] = None,
+        kernels_per_layer: Sequence[int] = (20, 50),
+        kfold: int = 5,
+        epochs: Sequence[int] = (20, 4, 1),
+        learning_rate: Sequence[float] = (1e-2, 1e-3, 1e-4),
+        batch_size: int = 128,
+        dense_units: int = 500,
+        dropout_rate: float = 0.5,
+        n_classes: Optional[int] = None,
+        momentum: float = 0.9,
+        nesterov: bool = False,
+        compute_dtype: str = "bfloat16",
+        seed: int = 0,
+    ):
+        super().__init__(x_train, y_train, genes)
+        self.config = dict(
+            nodes=tuple(int(k) for k in nodes),
+            input_shape=tuple(input_shape) if input_shape is not None else None,
+            kernels_per_layer=tuple(int(f) for f in kernels_per_layer),
+            kfold=int(kfold),
+            epochs=tuple(int(e) for e in epochs),
+            learning_rate=tuple(float(r) for r in learning_rate),
+            batch_size=int(batch_size),
+            dense_units=int(dense_units),
+            dropout_rate=float(dropout_rate),
+            n_classes=n_classes,
+            momentum=float(momentum),
+            nesterov=bool(nesterov),
+            compute_dtype=str(compute_dtype),
+            seed=int(seed),
+        )
+
+    def cross_validate(self) -> float:
+        return float(
+            self.cross_validate_population(self.x_train, self.y_train, [self.genes], **self.config)[0]
+        )
+
+    # -- the population-batched path (used by Population.evaluate) ---------
+
+    @classmethod
+    def cross_validate_population(
+        cls,
+        x_train,
+        y_train,
+        genomes: Sequence[Mapping[str, Any]],
+        **config,
+    ) -> np.ndarray:
+        """k-fold CV fitness for P genomes in one vmapped program per fold.
+
+        Returns an array of P mean validation accuracies.  All genomes train
+        simultaneously: the population axis is vmapped, so XLA sees one
+        computation with P-wide batched convolutions.
+        """
+        cfg = _normalize_config(x_train, y_train, config)
+        x, y = _prepare_data(x_train, y_train, cfg)
+        nodes = cfg["nodes"]
+        pop = len(genomes)
+        if pop == 0:
+            return np.zeros((0,), dtype=np.float32)
+
+        stacked = [
+            {k: jnp.asarray(v) for k, v in stage.items()}
+            for stage in stack_genome_masks(genomes, nodes)
+        ]
+        model = MaskedGeneticCnn(
+            nodes=nodes,
+            filters=cfg["kernels_per_layer"],
+            dense_units=cfg["dense_units"],
+            n_classes=cfg["n_classes"],
+            dropout_rate=cfg["dropout_rate"],
+            compute_dtype=jnp.dtype(cfg["compute_dtype"]),
+        )
+
+        kfold = cfg["kfold"]
+        n = x.shape[0]
+        if kfold < 2:
+            raise ValueError("kfold must be >= 2")
+        fold_size = n // kfold
+        if fold_size == 0:
+            raise ValueError(f"kfold={kfold} exceeds dataset size {n}")
+        n_use = fold_size * kfold  # equal folds → one compiled shape
+        rng = np.random.default_rng(cfg["seed"])
+        perm = rng.permutation(n)[:n_use]
+        folds = perm.reshape(kfold, fold_size)
+
+        batch_size = min(cfg["batch_size"], n_use - fold_size)
+        n_tr = n_use - fold_size
+        steps_per_epoch = max(n_tr // batch_size, 1)
+        total_steps = sum(cfg["epochs"]) * steps_per_epoch
+        n_val_padded = int(np.ceil(fold_size / batch_size)) * batch_size
+
+        fn = _population_cv_fn(
+            nodes,
+            cfg["kernels_per_layer"],
+            cfg["dense_units"],
+            cfg["n_classes"],
+            cfg["dropout_rate"],
+            cfg["compute_dtype"],
+            cfg["epochs"],
+            cfg["learning_rate"],
+            cfg["momentum"],
+            cfg["nesterov"],
+            batch_size,
+            n_tr,
+            n_val_padded,
+        )
+
+        accs = np.zeros((kfold, pop), dtype=np.float32)
+        base_key = jax.random.PRNGKey(cfg["seed"])
+        for f in range(kfold):
+            val_idx = folds[f]
+            tr_idx = np.concatenate([folds[g] for g in range(kfold) if g != f])
+            # Per-epoch shuffled batch indices, host-side: (steps, batch).
+            order = np.concatenate(
+                [rng.permutation(n_tr) for _ in range(sum(cfg["epochs"]))]
+            )[: total_steps * batch_size]
+            batch_idx = order.reshape(total_steps, batch_size)
+
+            pad = n_val_padded - fold_size
+            val_idx_padded = np.concatenate([val_idx, np.full(pad, val_idx[0])])
+            val_weight = np.concatenate(
+                [np.ones(fold_size, np.float32), np.zeros(pad, np.float32)]
+            )
+
+            params = _init_population_params(
+                model, stacked, cfg["input_shape"], pop, cfg["seed"] + f
+            )
+            fold_keys = jax.random.split(jax.random.fold_in(base_key, f), pop)
+            acc, _ = fn(
+                params,
+                stacked,
+                jnp.asarray(x[tr_idx]),
+                jnp.asarray(y[tr_idx]),
+                jnp.asarray(x[val_idx_padded]),
+                jnp.asarray(y[val_idx_padded]),
+                jnp.asarray(val_weight),
+                jnp.asarray(batch_idx),
+                fold_keys,
+            )
+            accs[f] = np.asarray(acc)
+        return accs.mean(axis=0)
+
+
+def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill inferred fields (input_shape, n_classes) and canonicalise types."""
+    defaults = dict(
+        nodes=(3, 5),
+        input_shape=None,
+        kernels_per_layer=(20, 50),
+        kfold=5,
+        epochs=(20, 4, 1),
+        learning_rate=(1e-2, 1e-3, 1e-4),
+        batch_size=128,
+        dense_units=500,
+        dropout_rate=0.5,
+        n_classes=None,
+        momentum=0.9,
+        nesterov=False,
+        compute_dtype="bfloat16",
+        seed=0,
+    )
+    unknown = set(config) - set(defaults)
+    if unknown:
+        raise TypeError(f"unknown GeneticCnnModel parameters: {sorted(unknown)}")
+    cfg = {**defaults, **config}
+    cfg["nodes"] = tuple(int(k) for k in cfg["nodes"])
+    cfg["kernels_per_layer"] = tuple(int(f) for f in cfg["kernels_per_layer"])
+    if len(cfg["kernels_per_layer"]) != len(cfg["nodes"]):
+        raise ValueError("kernels_per_layer must have one entry per stage")
+    cfg["epochs"] = tuple(int(e) for e in cfg["epochs"])
+    cfg["learning_rate"] = tuple(float(r) for r in cfg["learning_rate"])
+    if len(cfg["epochs"]) != len(cfg["learning_rate"]):
+        raise ValueError("epochs and learning_rate must be parallel tuples")
+    x = np.asarray(x_train)
+    if cfg["input_shape"] is None:
+        if x.ndim == 4:
+            cfg["input_shape"] = tuple(x.shape[1:])
+        elif x.ndim == 3:
+            cfg["input_shape"] = (*x.shape[1:], 1)
+        else:
+            raise ValueError(
+                "input_shape is required for flat inputs (cannot infer HWC from "
+                f"array of shape {x.shape})"
+            )
+    else:
+        cfg["input_shape"] = tuple(int(d) for d in cfg["input_shape"])
+    if cfg["n_classes"] is None:
+        cfg["n_classes"] = int(np.max(np.asarray(y_train))) + 1
+    cfg["n_classes"] = int(cfg["n_classes"])
+    return cfg
+
+
+def _prepare_data(x_train, y_train, cfg: Dict[str, Any]):
+    """float32 NHWC images + int32 labels, reshaping flat inputs if needed."""
+    x = np.asarray(x_train, dtype=np.float32)
+    if x.ndim != 4:
+        x = x.reshape((x.shape[0], *cfg["input_shape"]))
+    y = np.asarray(y_train, dtype=np.int32)
+    if x.shape[0] != y.shape[0]:
+        raise ValueError(f"x/y length mismatch: {x.shape[0]} vs {y.shape[0]}")
+    return x, y

@@ -94,8 +94,9 @@ class StageMasks:
     - ``exit``: ``(k,)``; 1 ⇒ this node's output is summed into the stage
       output.
     - ``has_active``: scalar; 0 ⇒ the stage has no active nodes and the
-      stage output is the stage input passed through unchanged (identity
-      stage, pooling still applies).
+      stage output is the *default input node* (in the consumer,
+      ``models/cnn.py``, that is the stage-entry Conv+ReLU output — not the
+      raw stage input); pooling still applies.
     """
 
     adj: np.ndarray

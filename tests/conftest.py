@@ -8,10 +8,23 @@ the top of conftest rather than in a fixture.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 existing = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in existing:
     os.environ["XLA_FLAGS"] = (existing + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize registers the real TPU at interpreter startup and
+# pins jax_platforms=axon via jax.config, which overrides the env var — so
+# tests must override it back at the config level before any backend
+# initialization.  Tests must NEVER touch the real chip: a second process
+# holding the TPU can hang every other jax process on the machine.
+# (Guarded so the pure-numpy tests still run on jax-less minimal installs.)
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
 
 import numpy as np
 import pytest

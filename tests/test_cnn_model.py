@@ -1,0 +1,182 @@
+"""Tests for the masked-supergraph Genetic-CNN fitness model (models/cnn.py).
+
+SURVEY.md §4: the rebuild must supply genome→module decode tests and
+single-chip train-step correctness the reference never had.  Everything here
+runs on the virtual CPU mesh (conftest pins jax to cpu).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gentun_tpu.models.cnn import GeneticCnnModel, MaskedGeneticCnn, _population_cv_fn
+from gentun_tpu.ops.dag import stack_genome_masks
+
+FAST = dict(
+    nodes=(3,),
+    kernels_per_layer=(8,),
+    kfold=2,
+    epochs=(2,),
+    learning_rate=(0.05,),
+    batch_size=32,
+    dense_units=32,
+    compute_dtype="float32",
+    seed=0,
+)
+
+
+@pytest.fixture(scope="module")
+def separable_data():
+    """4 classes of 8×8 images with distinct mean patterns — easy to learn."""
+    rng = np.random.default_rng(0)
+    protos = rng.normal(size=(4, 8, 8, 1)).astype(np.float32)
+    y = rng.integers(0, 4, size=192).astype(np.int32)
+    x = protos[y] + 0.3 * rng.normal(size=(192, 8, 8, 1)).astype(np.float32)
+    return x, y
+
+
+def _masks_for(genes, nodes):
+    return [
+        {k: jnp.asarray(v[0]) for k, v in stage.items()}
+        for stage in stack_genome_masks([genes], nodes)
+    ]
+
+
+class TestMaskedGeneticCnnForward:
+    def test_output_shape_two_stages(self):
+        model = MaskedGeneticCnn(
+            nodes=(3, 5), filters=(4, 8), dense_units=16, n_classes=10,
+            compute_dtype=jnp.float32,
+        )
+        genes = {"S_1": (1, 0, 1), "S_2": (1,) * 10}
+        masks = _masks_for(genes, (3, 5))
+        x = jnp.zeros((2, 16, 16, 1))
+        params = model.init(jax.random.PRNGKey(0), x, masks)
+        out = model.apply(params, x, masks)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32
+
+    def test_identity_stage_matches_entry_conv_passthrough(self):
+        """All-zero genome ⇒ stage output is the entry conv output, pooled."""
+        model = MaskedGeneticCnn(
+            nodes=(3,), filters=(4,), dense_units=8, n_classes=2,
+            compute_dtype=jnp.float32,
+        )
+        masks = _masks_for({"S_1": (0, 0, 0)}, (3,))
+        x = jnp.ones((1, 8, 8, 1))
+        params = model.init(jax.random.PRNGKey(1), x, masks)
+        out = model.apply(params, x, masks)
+        assert out.shape == (1, 2)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_inactive_node_gradients_are_zero(self):
+        """Masking correctness: a dropped node must not touch the loss.
+
+        Genome (1, 0, 0) has the chain 0→1 and node 2 isolated — every
+        gradient of stage0_node2's conv must be exactly zero, while active
+        nodes' gradients are not.
+        """
+        model = MaskedGeneticCnn(
+            nodes=(3,), filters=(4,), dense_units=8, n_classes=2,
+            compute_dtype=jnp.float32,
+        )
+        masks = _masks_for({"S_1": (1, 0, 0)}, (3,))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 8, 8, 1)), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(2), x, masks)
+
+        def loss(params):
+            return model.apply({"params": params}, x, masks).sum()
+
+        grads = jax.grad(loss)(variables["params"])
+        dead = grads["stage0_node2"]["kernel"]
+        live = grads["stage0_node0"]["kernel"]
+        assert np.all(np.asarray(dead) == 0.0)
+        assert np.any(np.asarray(live) != 0.0)
+
+    def test_isomorphic_genomes_same_program_different_masks(self):
+        """1→2 chain vs 2→3 chain: same compiled fn, numerically same loss
+        landscape up to parameter relabeling — here we just assert both run
+        through one shared program (no retrace) and give finite outputs."""
+        model = MaskedGeneticCnn(
+            nodes=(3,), filters=(4,), dense_units=8, n_classes=2,
+            compute_dtype=jnp.float32,
+        )
+        x = jnp.ones((1, 8, 8, 1))
+        traces = []
+
+        @jax.jit
+        def fwd(params, masks):
+            traces.append(1)
+            return model.apply(params, x, masks)
+
+        m1 = _masks_for({"S_1": (1, 0, 0)}, (3,))
+        m2 = _masks_for({"S_1": (0, 0, 1)}, (3,))
+        params = model.init(jax.random.PRNGKey(0), x, m1)
+        out1 = fwd(params, m1)
+        out2 = fwd(params, m2)
+        assert len(traces) == 1  # masks are data: one trace serves all genomes
+        assert np.isfinite(np.asarray(out1)).all() and np.isfinite(np.asarray(out2)).all()
+
+
+class TestGeneticCnnModelCV:
+    def test_learns_separable_data(self, separable_data):
+        x, y = separable_data
+        m = GeneticCnnModel(x, y, {"S_1": (1, 0, 1)}, **FAST)
+        fit = m.cross_validate()
+        assert 0.5 < fit <= 1.0
+
+    def test_population_path_matches_shapes_and_learns(self, separable_data):
+        x, y = separable_data
+        genomes = [
+            {"S_1": (0, 0, 0)},
+            {"S_1": (1, 0, 1)},
+            {"S_1": (1, 1, 1)},
+        ]
+        accs = GeneticCnnModel.cross_validate_population(x, y, genomes, **FAST)
+        assert accs.shape == (3,)
+        assert (accs > 0.4).all()
+
+    def test_flat_input_reshape(self, separable_data):
+        x, y = separable_data
+        flat = x.reshape(x.shape[0], -1)
+        m = GeneticCnnModel(
+            flat, y, {"S_1": (1, 0, 1)}, input_shape=(8, 8, 1), **FAST
+        )
+        assert 0.5 < m.cross_validate() <= 1.0
+
+    def test_compile_cache_no_retrace_across_calls(self, separable_data):
+        x, y = separable_data
+        before = _population_cv_fn.cache_info().hits
+        GeneticCnnModel.cross_validate_population(x, y, [{"S_1": (1, 1, 0)}], **FAST)
+        after = _population_cv_fn.cache_info()
+        # The earlier tests used identical static config: the factory must hit.
+        assert after.hits > before
+
+    def test_config_validation(self, separable_data):
+        x, y = separable_data
+        with pytest.raises(TypeError):
+            GeneticCnnModel(x, y, {"S_1": (0, 0, 0)}, bogus_knob=3, **FAST).cross_validate()
+        with pytest.raises(ValueError):
+            GeneticCnnModel(
+                x, y, {"S_1": (0, 0, 0)},
+                nodes=(3,), kernels_per_layer=(8, 8), kfold=2,
+                epochs=(1,), learning_rate=(0.1,), compute_dtype="float32",
+            ).cross_validate()
+        with pytest.raises(ValueError):  # epochs/lr not parallel
+            GeneticCnnModel(
+                x, y, {"S_1": (0, 0, 0)},
+                nodes=(3,), kernels_per_layer=(8,), kfold=2,
+                epochs=(1, 2), learning_rate=(0.1,), compute_dtype="float32",
+            ).cross_validate()
+
+    def test_staged_lr_schedule_runs(self, separable_data):
+        x, y = separable_data
+        m = GeneticCnnModel(
+            x, y, {"S_1": (1, 1, 1)},
+            nodes=(3,), kernels_per_layer=(8,), kfold=2,
+            epochs=(1, 1), learning_rate=(0.05, 0.005),
+            batch_size=32, dense_units=32, compute_dtype="float32", seed=1,
+        )
+        assert 0.0 <= m.cross_validate() <= 1.0
