@@ -72,9 +72,16 @@ except ImportError:  # pragma: no cover
     pass
 
 try:  # pragma: no cover
-    from .distributed.server import DistributedPopulation  # noqa: F401
+    from .distributed.server import DistributedPopulation, DistributedGridPopulation  # noqa: F401
     from .distributed.client import GentunClient  # noqa: F401
+    from .distributed.broker import JobBroker, JobFailed  # noqa: F401
 
-    __all__ += ["DistributedPopulation", "GentunClient"]
+    __all__ += [
+        "DistributedPopulation",
+        "DistributedGridPopulation",
+        "GentunClient",
+        "JobBroker",
+        "JobFailed",
+    ]
 except ImportError:  # pragma: no cover
     pass

@@ -115,17 +115,9 @@ class GeneticAlgorithm:
             father = self.select_parent()
             next_individuals.append(mother.reproduce(father, self.rng))
 
-        self.population = Population(
-            species=self.population.species,
-            x_train=self.population.x_train,
-            y_train=self.population.y_train,
-            individual_list=next_individuals,
-            crossover_rate=self.population.crossover_rate,
-            mutation_rate=self.population.mutation_rate,
-            maximize=self.population.maximize,
-            additional_parameters=self.population.additional_parameters,
-            rng=self.population.rng,
-        )
+        # clone_with keeps the population's concrete type across generations
+        # (a DistributedPopulation must carry its broker forward).
+        self.population = self.population.clone_with(next_individuals)
         self.generation += 1
         if self._checkpointer is not None:
             self._checkpointer.save(self)

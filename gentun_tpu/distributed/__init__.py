@@ -1,0 +1,21 @@
+"""Distribution layer: the master↔worker control plane over DCN.
+
+The rebuild's replacement for the reference's RabbitMQ transport
+(``gentun/server.py`` + ``gentun/client.py`` [PUB][BASELINE]; SURVEY.md §1
+L2, §5 "Distributed communication backend"): an embedded asyncio TCP/JSON
+broker with AMQP-equivalent at-least-once + competing-consumer semantics.
+Only genes, hyperparameters, and fitness scalars cross the wire; data and
+device collectives stay inside each worker (ICI, via jax).
+"""
+
+from .broker import JobBroker, JobFailed
+from .client import GentunClient
+from .server import DistributedGridPopulation, DistributedPopulation
+
+__all__ = [
+    "JobBroker",
+    "JobFailed",
+    "GentunClient",
+    "DistributedPopulation",
+    "DistributedGridPopulation",
+]

@@ -1,0 +1,261 @@
+"""Worker-side client: owns the data, trains genes shipped by the master.
+
+Reference parity: ``GentunClient`` in ``gentun/client.py`` [PUB][BASELINE]
+(SURVEY.md §2.0 row 11, §3.3).  Preserved behaviors:
+
+- the worker holds ``(x_train, y_train)``; only genes + hyperparameters
+  arrive, only fitness scalars leave;
+- ``work()`` is a blocking consume loop: pop job → rebuild individual from
+  genes → ``get_fitness()`` (the hot path) → reply → ack.  Here the ack IS
+  the ``result`` message (ack-after-work): a worker that dies mid-job never
+  acks, and the broker redelivers (at-least-once, SURVEY.md §5);
+- evaluation errors are reported (``fail``) rather than crashing the loop,
+  and the broker decides between redelivery and giving up.
+
+TPU-first extension: ``capacity > 1`` asks the broker for several jobs at
+once; jobs sharing one config are evaluated as a single vmapped population
+program via ``Population.evaluate`` (``models/cnn.py``), which is how one
+TPU worker keeps its chip saturated even mid-generation.  Heartbeats run on
+a side thread so a minutes-long jitted train step doesn't make a healthy
+worker look dead.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Type
+
+from ..individuals import Individual
+from ..populations import Population
+from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
+
+__all__ = ["GentunClient"]
+
+logger = logging.getLogger("gentun_tpu.distributed")
+
+
+class GentunClient:
+    """Connects to the master's broker and evaluates individuals forever.
+
+    Parameters mirror the reference constructor
+    (``GentunClient(IndividualCls, x_train, y_train, host, user, password)``
+    [PUB]); ``user`` is accepted for signature parity but unused, ``password``
+    maps to the broker token.
+
+    - ``species``: the Individual subclass to rebuild from wire genes.
+    - ``capacity``: max jobs held at once (1 = reference semantics; >1 lets
+      a TPU worker train a whole batch in one compiled program).
+    - ``heartbeat_interval``: seconds between pings from the side thread.
+    """
+
+    def __init__(
+        self,
+        species: Type[Individual],
+        x_train,
+        y_train,
+        host: str = "127.0.0.1",
+        port: int = 5672,
+        user: Optional[str] = None,
+        password: Optional[str] = None,
+        capacity: int = 1,
+        heartbeat_interval: float = 3.0,
+        reconnect_delay: float = 1.0,
+        worker_id: Optional[str] = None,
+    ):
+        self.species = species
+        self.x_train = x_train
+        self.y_train = y_train
+        self.host = host
+        self.port = int(port)
+        self.token = password
+        self.capacity = max(1, int(capacity))
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.reconnect_delay = float(reconnect_delay)
+        self.worker_id = worker_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._write_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._handshaken = threading.Event()  # gates heartbeats until welcome
+        self._jobs_done = 0
+
+    # -- connection --------------------------------------------------------
+
+    def _connect(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=10.0)
+        sock.settimeout(None)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._send({"type": "hello", "worker_id": self.worker_id, "token": self.token, "capacity": self.capacity})
+        reply = self._recv()
+        if reply.get("type") != "welcome":
+            raise ConnectionError(f"broker rejected worker: {reply}")
+        self._handshaken.set()
+        logger.info("worker %s connected to %s:%d", self.worker_id, self.host, self.port)
+
+    def _close(self) -> None:
+        self._handshaken.clear()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+
+    def _send(self, msg: Dict[str, Any]) -> None:
+        with self._write_lock:
+            sock = self._sock
+            if sock is None:
+                raise OSError("not connected")
+            sock.sendall(encode(msg))
+
+    def _recv(self) -> Dict[str, Any]:
+        line = self._rfile.readline(MAX_MESSAGE_BYTES + 2)
+        if not line:
+            raise ConnectionError("broker closed connection")
+        return decode(line)
+
+    def _heartbeat_loop(self) -> None:
+        """Pings from a side thread keep liveness visible during training.
+
+        Only pings once the hello/welcome handshake is done (a ping as the
+        first frame would be a protocol violation), and survives any race
+        with ``_close`` nulling the socket mid-send.
+        """
+        while not self._stop.is_set():
+            time.sleep(self.heartbeat_interval)
+            if not self._handshaken.is_set():
+                continue
+            try:
+                self._send({"type": "ping"})
+            except Exception:
+                pass  # main loop will notice and reconnect
+
+    # -- the consume loop --------------------------------------------------
+
+    def work(self, max_jobs: Optional[int] = None, stop_event: Optional[threading.Event] = None) -> int:
+        """Blocking consume loop (reference ``GentunClient.work()`` [PUB]).
+
+        Returns the number of jobs completed (useful for tests); runs until
+        ``stop_event`` is set or ``max_jobs`` results have been sent.
+        """
+        stop = stop_event or threading.Event()
+        self._stop = threading.Event()
+        self._jobs_done = 0  # each work() call gets a fresh budget
+        hb = threading.Thread(target=self._heartbeat_loop, name="gentun-heartbeat", daemon=True)
+        hb.start()
+        try:
+            while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
+                try:
+                    self._connect()
+                    self._consume(stop, max_jobs)
+                except (ConnectionError, OSError, ProtocolError) as e:
+                    if stop.is_set() or (max_jobs is not None and self._jobs_done >= max_jobs):
+                        break
+                    logger.info("worker %s reconnecting after: %s", self.worker_id, e)
+                    self._close()
+                    time.sleep(self.reconnect_delay)
+        finally:
+            self._stop.set()
+            self._close()
+        return self._jobs_done
+
+    def _consume(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
+        while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
+            self._send({"type": "ready", "credit": self.capacity})
+            jobs = [self._await_job()]
+            # Drain whatever the broker pushed alongside (capacity > 1): the
+            # batch then trains as one vmapped program.
+            jobs.extend(self._drain_jobs(self.capacity - 1))
+            self._evaluate_batch(jobs)
+
+    def _await_job(self) -> Dict[str, Any]:
+        while True:
+            msg = self._recv()
+            if msg["type"] == "job":
+                return msg
+            if msg["type"] not in ("pong", "welcome"):
+                logger.warning("unexpected message %r", msg["type"])
+
+    def _drain_jobs(self, budget: int) -> List[Dict[str, Any]]:
+        """Non-blocking-ish read of co-delivered jobs (50 ms window)."""
+        jobs: List[Dict[str, Any]] = []
+        if budget <= 0:
+            return jobs
+        self._sock.settimeout(0.05)
+        try:
+            while len(jobs) < budget:
+                try:
+                    msg = self._recv()
+                except (socket.timeout, TimeoutError):
+                    break
+                if msg["type"] == "job":
+                    jobs.append(msg)
+        finally:
+            self._sock.settimeout(None)
+        return jobs
+
+    # -- evaluation --------------------------------------------------------
+
+    def _evaluate_batch(self, jobs: List[Dict[str, Any]]) -> None:
+        """Rebuild individuals from wire genes and train them.
+
+        Jobs sharing identical ``additional_parameters`` go through
+        ``Population.evaluate`` so the species' batched (vmapped) path is
+        used when available; singletons fall back to ``get_fitness()``.
+        """
+        groups: Dict[str, List[Dict[str, Any]]] = {}
+        for job in jobs:
+            key = repr(sorted((job.get("additional_parameters") or {}).items()))
+            groups.setdefault(key, []).append(job)
+
+        for group in groups.values():
+            params = group[0].get("additional_parameters") or {}
+            individuals = []
+            ok_jobs = []
+            for job in group:
+                try:
+                    ind = self.species(
+                        x_train=self.x_train,
+                        y_train=self.y_train,
+                        genes=job["genes"],
+                        additional_parameters=dict(params),
+                    )
+                    individuals.append(ind)
+                    ok_jobs.append(job)
+                except Exception as e:  # bad genes off the wire
+                    logger.exception("job %s: cannot build individual", job["job_id"])
+                    self._try_send_fail(job["job_id"], f"build: {e!r}")
+            if not individuals:
+                continue
+            pop = Population(
+                self.species,
+                x_train=self.x_train,
+                y_train=self.y_train,
+                individual_list=individuals,
+                additional_parameters=dict(params),
+            )
+            try:
+                pop.evaluate()
+                for job, ind in zip(ok_jobs, individuals):
+                    self._send({"type": "result", "job_id": job["job_id"], "fitness": ind.get_fitness()})
+                    self._jobs_done += 1
+                    logger.info("job %s done: fitness %.6g", job["job_id"], ind.get_fitness())
+            except Exception as e:
+                # Evaluation is all-or-nothing per group: report every job so
+                # the broker can redeliver (ack-after-work semantics).
+                logger.exception("batch evaluation failed")
+                for job in ok_jobs:
+                    self._try_send_fail(job["job_id"], f"evaluate: {e!r}")
+
+    def _try_send_fail(self, job_id: str, reason: str) -> None:
+        try:
+            self._send({"type": "fail", "job_id": job_id, "reason": reason[:2000]})
+        except OSError:
+            pass  # connection gone; broker requeues via disconnect path

@@ -1,0 +1,65 @@
+"""Wire protocol for the master↔worker control plane.
+
+Reference parity: gentun ships JSON jobs over RabbitMQ (AMQP) with an RPC
+reply queue + correlation ids (``gentun/server.py``/``client.py`` [PUB];
+SURVEY.md §3.2-3.3).  No broker exists in this environment (SURVEY.md §2.1),
+so the rebuild speaks its own minimal protocol: **newline-delimited JSON over
+TCP**, carrying exactly what the reference carried — genes, additional
+parameters, fitness scalars — and nothing else.  Genes are tiny by design;
+wire cost is irrelevant (SURVEY.md §1 "Workers own the training data").
+
+Message types:
+
+====================  =====================================================
+worker → broker       ``hello`` {worker_id, token, capacity}
+broker → worker       ``welcome`` {} | ``error`` {reason}
+worker → broker       ``ready`` {credit}        request up to `credit` jobs
+broker → worker       ``job`` {job_id, genes, additional_parameters}
+worker → broker       ``result`` {job_id, fitness}   = the ack (ack-after-work)
+worker → broker       ``fail`` {job_id, reason}      evaluation raised
+worker → broker       ``ping`` {}               liveness, from a side thread
+broker → worker       ``pong`` {}
+====================  =====================================================
+
+Delivery semantics (matching AMQP's, SURVEY.md §5 "Failure detection"):
+at-least-once.  A job is requeued when its worker disconnects or stops
+pinging before sending ``result``; the master deduplicates by ``job_id`` and
+keeps the first fitness, so redelivery never double-counts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+__all__ = ["encode", "decode", "MAX_MESSAGE_BYTES", "ProtocolError"]
+
+#: Hard cap per message; genes + params are a few KB, so anything huge is a
+#: protocol violation (or an attempt to ship training data, which the design
+#: forbids — data lives with the worker).
+MAX_MESSAGE_BYTES = 4 * 1024 * 1024
+
+
+class ProtocolError(Exception):
+    """Malformed or oversized frame."""
+
+
+def encode(msg: Dict[str, Any]) -> bytes:
+    """Message dict → one newline-terminated JSON frame."""
+    data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(data) > MAX_MESSAGE_BYTES:
+        raise ProtocolError(f"message of {len(data)} bytes exceeds {MAX_MESSAGE_BYTES}")
+    return data + b"\n"
+
+
+def decode(line: bytes) -> Dict[str, Any]:
+    """One frame (without trailing newline requirement) → message dict."""
+    if len(line) > MAX_MESSAGE_BYTES:
+        raise ProtocolError(f"frame of {len(line)} bytes exceeds {MAX_MESSAGE_BYTES}")
+    try:
+        msg = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ProtocolError(f"bad JSON frame: {e}") from e
+    if not isinstance(msg, dict) or "type" not in msg:
+        raise ProtocolError(f"frame is not a typed message: {msg!r}")
+    return msg

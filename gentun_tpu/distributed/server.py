@@ -1,0 +1,172 @@
+"""Master-side distribution: a Population that farms fitness out to workers.
+
+Reference parity: ``DistributedPopulation`` (and the [UNCERTAIN]
+``DistributedGridPopulation``) in ``gentun/server.py`` [PUB][BASELINE]
+(SURVEY.md §2.0 row 10, §3.2).  Preserved semantics:
+
+- constructed WITHOUT training data — workers own the data, the master
+  ships only genes + ``additional_parameters`` and receives fitness scalars;
+- drop-in replacement for ``Population``: the GA outer loop is unchanged;
+- fitness evaluation publishes one job per unevaluated individual and
+  blocks until every reply arrives (the per-generation barrier);
+- at-least-once delivery with dedup is the broker's job
+  (``distributed/broker.py``).
+
+The broker is embedded: constructing a ``DistributedPopulation`` starts a
+TCP listener inside the master process (no external RabbitMQ — SURVEY.md
+§2.1), and successive generations share it via :meth:`clone_with`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Type
+
+import numpy as np
+
+from ..individuals import Individual
+from ..populations import GridPopulation, Population
+from .broker import JobBroker
+
+__all__ = ["DistributedPopulation", "DistributedGridPopulation"]
+
+logger = logging.getLogger("gentun_tpu.distributed")
+
+
+class DistributedPopulation(Population):
+    """Population whose fitness sweep runs on remote workers.
+
+    Extra constructor knobs versus :class:`Population` (data args are gone):
+
+    - ``host``/``port``: broker bind address (``port=0`` = ephemeral; read
+      the bound address from :attr:`broker_address` to point workers at it).
+    - ``user``/``password``: auth parity with the reference's RabbitMQ
+      kwargs [PUB]; ``password`` becomes the broker token.
+    - ``job_timeout``: per-generation barrier timeout in seconds (None =
+      wait forever, the reference's behavior).
+    - ``broker``: share an existing started :class:`JobBroker` instead of
+      owning one (used by :meth:`clone_with` across generations).
+    """
+
+    def __init__(
+        self,
+        species: Type[Individual],
+        individual_list: Optional[Sequence[Individual]] = None,
+        size: Optional[int] = None,
+        crossover_rate: float = 0.5,
+        mutation_rate: float = 0.015,
+        maximize: bool = True,
+        additional_parameters: Optional[Dict[str, Any]] = None,
+        seed: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        user: Optional[str] = None,
+        password: Optional[str] = None,
+        job_timeout: Optional[float] = None,
+        max_attempts: int = 3,
+        heartbeat_timeout: float = 15.0,
+        broker: Optional[JobBroker] = None,
+    ):
+        super().__init__(
+            species,
+            x_train=None,
+            y_train=None,
+            individual_list=individual_list,
+            size=size,
+            crossover_rate=crossover_rate,
+            mutation_rate=mutation_rate,
+            maximize=maximize,
+            additional_parameters=additional_parameters,
+            seed=seed,
+            rng=rng,
+        )
+        self.job_timeout = job_timeout
+        if broker is not None:
+            self.broker = broker
+            self._owns_broker = False
+        else:
+            self.broker = JobBroker(
+                host=host,
+                port=port,
+                token=password,
+                heartbeat_timeout=heartbeat_timeout,
+                max_attempts=max_attempts,
+            ).start()
+            self._owns_broker = True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def broker_address(self) -> tuple:
+        return self.broker.address
+
+    def close(self) -> None:
+        if self._owns_broker:
+            self.broker.stop()
+
+    def __enter__(self) -> "DistributedPopulation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the distributed fitness sweep ------------------------------------
+
+    def evaluate(self) -> None:
+        """Publish one job per unevaluated individual; block for all replies.
+
+        This is the reference's population-level fitness override
+        (SURVEY.md §3.2): genes out, fitness scalars back, barrier at the
+        end of the sweep.
+        """
+        pending = [ind for ind in self.individuals if not ind.fitness_evaluated]
+        if not pending:
+            return
+        payloads: Dict[str, Dict[str, Any]] = {}
+        by_id: Dict[str, Individual] = {}
+        for ind in pending:
+            job_id = JobBroker.new_job_id()
+            payloads[job_id] = {
+                "genes": ind.get_genes(),
+                "additional_parameters": dict(ind.additional_parameters),
+            }
+            by_id[job_id] = ind
+        logger.info("distributing %d fitness evaluations", len(payloads))
+        results = self.broker.evaluate(payloads, timeout=self.job_timeout)
+        for job_id, fitness in results.items():
+            by_id[job_id].set_fitness(fitness)
+
+    # -- generational continuity ------------------------------------------
+
+    def clone_with(self, individuals: Sequence[Individual]) -> "DistributedPopulation":
+        """Next-generation population sharing this one's running broker."""
+        return DistributedPopulation(
+            species=self.species,
+            individual_list=list(individuals),
+            crossover_rate=self.crossover_rate,
+            mutation_rate=self.mutation_rate,
+            maximize=self.maximize,
+            additional_parameters=self.additional_parameters,
+            rng=self.rng,
+            job_timeout=self.job_timeout,
+            broker=self.broker,
+        )
+
+
+class DistributedGridPopulation(DistributedPopulation):
+    """Grid-initialised distributed population (SURVEY.md §2.0 row 10).
+
+    First generation enumerates the cartesian product of ``genes_grid``
+    (like :class:`gentun_tpu.populations.GridPopulation`); later generations
+    evolve as a plain :class:`DistributedPopulation` via ``clone_with``.
+    """
+
+    def __init__(
+        self,
+        species: Type[Individual],
+        genes_grid: Optional[Mapping[str, Sequence[Any]]] = None,
+        **kwargs,
+    ):
+        super().__init__(species, individual_list=[], **kwargs)
+        self.populate_from_grid(genes_grid)

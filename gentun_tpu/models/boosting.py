@@ -1,0 +1,146 @@
+"""Gradient-boosting fitness model — the non-TPU control path.
+
+Reference parity: ``XgboostModel`` in ``gentun/models/xgboost_models.py``
+[PUB] (SURVEY.md §2.0 row 8): k-fold cross-validation of a gradient-boosted
+tree model over the genome's hyperparameters, fitness = mean validation
+metric.  xgboost is not installed in this environment (SURVEY.md §2.1), so
+the rebuild targets sklearn's ``HistGradientBoosting{Classifier,Regressor}``
+— the same histogram-based GBDT algorithm family — while keeping the model
+interface pluggable so a real xgboost backend can drop in unchanged.
+
+Genome keys are the sklearn constructor names (see
+:func:`gentun_tpu.genes.boosting_genome`); xgboost-style keys (from
+:func:`gentun_tpu.genes.xgboost_genome`) are translated where an equivalent
+exists and ignored otherwise, so reference-shaped genomes still run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from .generic import GentunModel
+
+__all__ = ["BoostingModel"]
+
+# xgboost name → (sklearn name, converter); best-effort translation for
+# reference-shaped genomes (gentun XgboostIndividual [PUB]).
+_XGB_TO_SKLEARN = {
+    "eta": ("learning_rate", float),
+    "max_depth": ("max_depth", int),
+    "lambda": ("l2_regularization", float),
+    "min_child_weight": ("min_samples_leaf", lambda v: max(1, int(round(v)))),
+}
+
+_SKLEARN_KEYS = {
+    "learning_rate",
+    "max_depth",
+    "max_leaf_nodes",
+    "min_samples_leaf",
+    "l2_regularization",
+    "max_bins",
+    "max_iter",
+}
+
+
+def _genes_to_params(genes: Mapping[str, Any]) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    for name, value in genes.items():
+        if name in _SKLEARN_KEYS:
+            params[name] = int(value) if name != "learning_rate" and name != "l2_regularization" else float(value)
+        elif name in _XGB_TO_SKLEARN:
+            target, conv = _XGB_TO_SKLEARN[name]
+            params.setdefault(target, conv(value))
+        # other xgboost-only knobs (gamma, subsample, ...) have no sklearn
+        # HistGradientBoosting equivalent; they are ignored, not an error,
+        # so reference genomes remain runnable.
+    if "learning_rate" in params:
+        params["learning_rate"] = float(params["learning_rate"])
+    if "max_depth" in params:
+        params["max_depth"] = int(params["max_depth"])
+    return params
+
+
+class BoostingModel(GentunModel):
+    """k-fold CV fitness for gradient-boosted trees (sklearn backend).
+
+    ``additional_parameters`` (mirroring the reference's kwargs style,
+    SURVEY.md §5 "Config / flag system"):
+
+    - ``kfold=5``: folds for cross-validation;
+    - ``task="classification"`` or ``"regression"``;
+    - ``metric``: ``"accuracy"`` (default, classification), ``"auc"``
+      (binary classification), ``"rmse"`` (default for regression; reported
+      negated so that *larger is always better* is up to the caller's
+      ``maximize`` flag — the raw mean metric is returned unmodified);
+    - ``seed=0``: fold-split seed;
+    - ``early_stopping=True``: sklearn's internal validation early stop,
+      the counterpart of ``xgb.cv``'s early stopping in the reference.
+    """
+
+    def __init__(
+        self,
+        x_train,
+        y_train,
+        genes: Mapping[str, Any],
+        kfold: int = 5,
+        task: str = "classification",
+        metric: str | None = None,
+        seed: int = 0,
+        early_stopping: bool = True,
+    ):
+        super().__init__(x_train, y_train, genes)
+        if task not in ("classification", "regression"):
+            raise ValueError(f"unknown task {task!r}")
+        self.kfold = int(kfold)
+        self.task = task
+        self.metric = metric or ("accuracy" if task == "classification" else "rmse")
+        self.seed = int(seed)
+        self.early_stopping = bool(early_stopping)
+        if self.task == "regression" and self.metric in ("accuracy", "auc"):
+            raise ValueError(f"metric {self.metric!r} requires classification")
+
+    def _build(self):
+        from sklearn.ensemble import (
+            HistGradientBoostingClassifier,
+            HistGradientBoostingRegressor,
+        )
+
+        params = _genes_to_params(self.genes)
+        cls = (
+            HistGradientBoostingClassifier
+            if self.task == "classification"
+            else HistGradientBoostingRegressor
+        )
+        return cls(
+            random_state=self.seed,
+            early_stopping=self.early_stopping,
+            **params,
+        )
+
+    def _score(self, model, x_val, y_val) -> float:
+        if self.metric == "accuracy":
+            return float(model.score(x_val, y_val))
+        if self.metric == "auc":
+            from sklearn.metrics import roc_auc_score
+
+            proba = model.predict_proba(x_val)[:, 1]
+            return float(roc_auc_score(y_val, proba))
+        if self.metric == "rmse":
+            pred = model.predict(x_val)
+            return float(np.sqrt(np.mean((pred - y_val) ** 2)))
+        raise ValueError(f"unknown metric {self.metric!r}")
+
+    def cross_validate(self) -> float:
+        """Mean validation metric over stratified/plain k-fold splits."""
+        from sklearn.model_selection import KFold, StratifiedKFold
+
+        splitter_cls = StratifiedKFold if self.task == "classification" else KFold
+        splitter = splitter_cls(n_splits=self.kfold, shuffle=True, random_state=self.seed)
+        scores = []
+        for tr_idx, val_idx in splitter.split(self.x_train, self.y_train):
+            model = self._build()
+            model.fit(self.x_train[tr_idx], self.y_train[tr_idx])
+            scores.append(self._score(model, self.x_train[val_idx], self.y_train[val_idx]))
+        return float(np.mean(scores))

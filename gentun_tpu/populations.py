@@ -85,6 +85,17 @@ class Population:
     def add_individual(self, individual: Individual) -> None:
         self.individuals.append(individual)
 
+    def populate_from_grid(self, genes_grid: Optional[Mapping[str, Sequence[Any]]] = None) -> None:
+        """Append one individual per point of the gene-value grid.
+
+        Shared by ``GridPopulation`` and ``DistributedGridPopulation``
+        (SURVEY.md §2.0 rows 4, 10): enumeration itself lives in
+        :meth:`GenomeSpec.grid`.
+        """
+        probe = self.spawn()
+        for genome in probe.spec.grid(gene_values=genes_grid):
+            self.add_individual(self.spawn(genes=genome))
+
     # -- container protocol (gentun exposes the same) ----------------------
 
     def __len__(self) -> int:
@@ -153,6 +164,29 @@ class Population:
             ind.set_fitness(float(fit))
         return True
 
+    # -- generational continuity ------------------------------------------
+
+    def clone_with(self, individuals: Sequence[Individual]) -> "Population":
+        """A next-generation population with this one's config and data.
+
+        The GA outer loop calls this instead of naming a class, so
+        subclasses (notably ``DistributedPopulation``, which must carry its
+        broker across generations) stay subclasses through evolution.
+        ``GridPopulation`` deliberately degrades to a plain ``Population``:
+        grid enumeration only describes generation zero.
+        """
+        return Population(
+            species=self.species,
+            x_train=self.x_train,
+            y_train=self.y_train,
+            individual_list=list(individuals),
+            crossover_rate=self.crossover_rate,
+            mutation_rate=self.mutation_rate,
+            maximize=self.maximize,
+            additional_parameters=self.additional_parameters,
+            rng=self.rng,
+        )
+
     def get_fittest(self) -> Individual:
         """Best individual under the population's direction (evaluating lazily)."""
         self.evaluate()
@@ -200,7 +234,4 @@ class GridPopulation(Population):
             seed=seed,
             rng=rng,
         )
-        # Need a spec to enumerate the grid; build a throwaway individual.
-        probe = self.spawn()
-        for genome in probe.spec.grid(gene_values=genes_grid):
-            self.add_individual(self.spawn(genes=genome))
+        self.populate_from_grid(genes_grid)

@@ -1,0 +1,89 @@
+"""Tests for the gradient-boosting control path (models/boosting.py)."""
+
+import numpy as np
+import pytest
+
+from gentun_tpu import BoostingIndividual, GeneticAlgorithm, Population
+from gentun_tpu.genes import boosting_genome, xgboost_genome
+from gentun_tpu.models.boosting import BoostingModel, _genes_to_params
+
+
+@pytest.fixture(scope="module")
+def tabular_data():
+    """Binary classification with informative features."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 8))
+    logits = x[:, 0] * 2.0 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logits + 0.3 * rng.normal(size=400) > 0).astype(np.int64)
+    return x, y
+
+
+def test_genes_translate_sklearn_names():
+    genes = boosting_genome().default()
+    params = _genes_to_params(genes)
+    assert params["learning_rate"] == pytest.approx(0.1)
+    assert params["max_depth"] == 6
+    assert set(params) <= {
+        "learning_rate", "max_depth", "max_leaf_nodes", "min_samples_leaf",
+        "l2_regularization", "max_bins", "max_iter",
+    }
+
+
+def test_genes_translate_xgboost_names():
+    genes = xgboost_genome().default()
+    params = _genes_to_params(genes)
+    # eta→learning_rate, lambda→l2_regularization; unknown knobs dropped
+    assert params["learning_rate"] == pytest.approx(0.3)
+    assert params["l2_regularization"] == pytest.approx(1.0)
+    assert "gamma" not in params and "subsample" not in params
+
+
+def test_cross_validate_classification(tabular_data):
+    x, y = tabular_data
+    genes = boosting_genome().default()
+    genes["max_iter"] = 30
+    model = BoostingModel(x, y, genes, kfold=3, seed=0)
+    acc = model.cross_validate()
+    assert 0.7 < acc <= 1.0
+
+
+def test_cross_validate_auc(tabular_data):
+    x, y = tabular_data
+    genes = boosting_genome().default()
+    genes["max_iter"] = 30
+    auc = BoostingModel(x, y, genes, kfold=3, metric="auc", seed=0).cross_validate()
+    assert 0.7 < auc <= 1.0
+
+
+def test_cross_validate_regression():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 5))
+    y = x[:, 0] * 3 + x[:, 1] ** 2 + 0.1 * rng.normal(size=300)
+    genes = boosting_genome().default()
+    genes["max_iter"] = 50
+    rmse = BoostingModel(x, y, genes, kfold=3, task="regression", seed=0).cross_validate()
+    assert 0.0 < rmse < 1.5  # near-noise-floor fit
+
+
+def test_invalid_config():
+    x, y = np.zeros((10, 2)), np.zeros(10)
+    with pytest.raises(ValueError):
+        BoostingModel(x, y, {}, task="ranking")
+    with pytest.raises(ValueError):
+        BoostingModel(x, y, {}, task="regression", metric="accuracy")
+
+
+def test_boosting_ga_search_improves(tabular_data):
+    """BASELINE config #3 shape: hyperparameter GA over the boosting genome."""
+    x, y = tabular_data
+    pop = Population(
+        BoostingIndividual,
+        x_train=x,
+        y_train=y,
+        size=6,
+        seed=3,
+        additional_parameters={"kfold": 2, "seed": 0},
+    )
+    ga = GeneticAlgorithm(pop, seed=3)
+    best = ga.run(2)
+    assert best.get_fitness() > 0.75

@@ -1,0 +1,280 @@
+"""Distributed-layer tests: broker semantics + fault injection.
+
+SURVEY.md §4 "Consequence for the rebuild": distributed tests without a
+cluster — in-process broker, worker threads/processes, fault injection
+(worker death mid-job ⇒ redelivery), all on localhost TCP.
+"""
+
+import multiprocessing
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import (
+    DistributedGridPopulation,
+    DistributedPopulation,
+    GentunClient,
+    JobBroker,
+    JobFailed,
+)
+from gentun_tpu.distributed.protocol import decode, encode
+
+
+class OneMax(Individual):
+    """Cheap deterministic fitness: count of set bits."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+class SlowOneMax(OneMax):
+    def evaluate(self):
+        time.sleep(float(self.additional_parameters.get("delay", 0.5)))
+        return super().evaluate()
+
+
+class AlwaysFails(OneMax):
+    def evaluate(self):
+        raise RuntimeError("boom")
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+def _run_worker(species, port, password=None, capacity=1, max_jobs=None, delay_params=None):
+    client = GentunClient(
+        species,
+        *DATA,
+        host="127.0.0.1",
+        port=port,
+        password=password,
+        capacity=capacity,
+        heartbeat_interval=0.2,
+        reconnect_delay=0.1,
+    )
+    return client.work(max_jobs=max_jobs)
+
+
+def _start_worker_thread(species, port, **kw):
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: GentunClient(
+            species, *DATA, host="127.0.0.1", port=port,
+            password=kw.get("password"), capacity=kw.get("capacity", 1),
+            heartbeat_interval=0.2, reconnect_delay=0.1,
+        ).work(stop_event=stop),
+        daemon=True,
+    )
+    t.start()
+    return stop, t
+
+
+def _worker_process_main(port):
+    """Forked worker that takes a slow job — the kill-target."""
+    _run_worker(SlowOneMax, port)
+
+
+@pytest.fixture
+def pop4():
+    p = DistributedPopulation(OneMax, size=4, seed=0, port=0)
+    yield p
+    p.close()
+
+
+class TestBrokerBasics:
+    def test_evaluate_with_one_worker(self, pop4):
+        _, port = pop4.broker_address
+        stop, _ = _start_worker_thread(OneMax, port)
+        try:
+            pop4.evaluate()
+            fits = [ind.get_fitness() for ind in pop4]
+            expected = [float(sum(sum(g) for g in ind.genes.values())) for ind in pop4]
+            assert fits == expected
+        finally:
+            stop.set()
+
+    def test_competing_consumers_split_work(self):
+        with DistributedPopulation(OneMax, size=12, seed=1, port=0) as pop:
+            _, port = pop.broker_address
+            stops = [_start_worker_thread(OneMax, port)[0] for _ in range(3)]
+            try:
+                pop.evaluate()
+                assert all(ind.fitness_evaluated for ind in pop)
+            finally:
+                for s in stops:
+                    s.set()
+
+    def test_capacity_batching(self):
+        """capacity>1 workers receive job batches and answer them all."""
+        with DistributedPopulation(OneMax, size=8, seed=2, port=0) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(OneMax, port, capacity=4)
+            try:
+                pop.evaluate()
+                assert all(ind.fitness_evaluated for ind in pop)
+            finally:
+                stop.set()
+
+    def test_bad_token_rejected(self):
+        with DistributedPopulation(OneMax, size=2, seed=0, port=0, password="s3cret") as pop:
+            _, port = pop.broker_address
+            # wrong password: worker is rejected, jobs stay pending
+            client = GentunClient(OneMax, *DATA, port=port, password="wrong", reconnect_delay=0.05)
+            with pytest.raises((ConnectionError, OSError)):
+                client._connect()
+            # right password: work completes
+            stop, _ = _start_worker_thread(OneMax, port, password="s3cret")
+            try:
+                pop.evaluate()
+                assert all(ind.fitness_evaluated for ind in pop)
+            finally:
+                stop.set()
+
+    def test_gather_timeout(self):
+        with DistributedPopulation(OneMax, size=2, seed=0, port=0, job_timeout=0.3) as pop:
+            with pytest.raises(TimeoutError):
+                pop.evaluate()  # no workers connected
+
+    def test_duplicate_result_first_wins(self):
+        broker = JobBroker(port=0).start()
+        try:
+            broker.submit({"j1": {"genes": {}, "additional_parameters": {}}})
+            time.sleep(0.2)  # let the loop thread enqueue
+
+            class W:  # stand-in worker for the dedup bookkeeping
+                def __init__(self):
+                    self.in_flight = {"j1"}
+
+            broker._on_result(W(), {"type": "result", "job_id": "j1", "fitness": 1.0})
+            # redelivery race: a second worker reports later — dropped
+            broker._on_result(W(), {"type": "result", "job_id": "j1", "fitness": 9.0})
+            assert broker.gather(["j1"], timeout=1.0) == {"j1": 1.0}
+            # gather pruned master-side state (SURVEY.md long-search hygiene)
+            assert broker._results == {} and broker._payloads == {}
+        finally:
+            broker.stop()
+
+
+class TestFaultInjection:
+    def test_worker_killed_mid_job_redelivers(self):
+        """SIGKILL a worker holding a job; the survivor finishes everything."""
+        with DistributedPopulation(
+            SlowOneMax, size=3, seed=3, port=0,
+            additional_parameters={"delay": 0.6},
+        ) as pop:
+            _, port = pop.broker_address
+            ctx = multiprocessing.get_context("fork")
+            victim = ctx.Process(target=_worker_process_main, args=(port,), daemon=True)
+            victim.start()
+
+            done = {}
+
+            def master():
+                pop.evaluate()
+                done["ok"] = all(ind.fitness_evaluated for ind in pop)
+
+            mt = threading.Thread(target=master, daemon=True)
+            mt.start()
+            time.sleep(1.0)  # victim has taken a job and is mid-evaluation
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.join(timeout=5.0)
+
+            stop, _ = _start_worker_thread(SlowOneMax, port)
+            try:
+                mt.join(timeout=30.0)
+                assert done.get("ok"), "master barrier did not complete after redelivery"
+            finally:
+                stop.set()
+
+    def test_hung_worker_heartbeat_reaper_redelivers(self):
+        """A worker that takes a job and goes silent (no pings) is reaped."""
+        with DistributedPopulation(
+            OneMax, size=2, seed=4, port=0, heartbeat_timeout=1.0,
+        ) as pop:
+            _, port = pop.broker_address
+            # Hand-rolled zombie: speaks hello/ready, takes jobs, never pings.
+            sock = socket.create_connection(("127.0.0.1", port))
+            rfile = sock.makefile("rb")
+            sock.sendall(encode({"type": "hello", "worker_id": "zombie", "capacity": 2}))
+            assert decode(rfile.readline())["type"] == "welcome"
+            sock.sendall(encode({"type": "ready", "credit": 2}))
+
+            done = {}
+
+            def master():
+                pop.evaluate()
+                done["ok"] = all(ind.fitness_evaluated for ind in pop)
+
+            mt = threading.Thread(target=master, daemon=True)
+            mt.start()
+            # zombie receives the jobs, holds them silently
+            time.sleep(0.5)
+            stop, _ = _start_worker_thread(OneMax, port)
+            try:
+                mt.join(timeout=15.0)
+                assert done.get("ok"), "reaper did not requeue the zombie's jobs"
+            finally:
+                stop.set()
+                sock.close()
+
+    def test_failing_job_exhausts_attempts(self):
+        with DistributedPopulation(
+            AlwaysFails, size=1, seed=5, port=0, max_attempts=2, job_timeout=20.0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(AlwaysFails, port)
+            try:
+                with pytest.raises(JobFailed):
+                    pop.evaluate()
+            finally:
+                stop.set()
+
+
+class TestDistributedGA:
+    def test_full_search_over_workers(self):
+        """BASELINE config #4's shape on one host: GA × broker × 2 workers."""
+        with DistributedPopulation(OneMax, size=8, seed=6, port=0) as pop:
+            _, port = pop.broker_address
+            stops = [_start_worker_thread(OneMax, port)[0] for _ in range(2)]
+            try:
+                ga = GeneticAlgorithm(pop, seed=6)
+                best = ga.run(3)
+                assert best.get_fitness() >= 9  # (4,4) nodes → 12 bits max
+                # clone_with preserved distribution across generations
+                assert isinstance(ga.population, DistributedPopulation)
+                assert ga.population.broker is pop.broker
+            finally:
+                for s in stops:
+                    s.set()
+
+    def test_grid_population_distributed(self):
+        with DistributedGridPopulation(
+            OneMax,
+            genes_grid={"S_1": [(0,) * 6, (1,) * 6], "S_2": [(1,) * 6]},
+            additional_parameters={"nodes": (4, 4)},
+            port=0,
+        ) as pop:
+            assert len(pop) == 2
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(OneMax, port)
+            try:
+                fits = pop.get_fitnesses()
+                assert sorted(fits) == [6.0, 12.0]
+            finally:
+                stop.set()
+
+
+def test_clone_with_preserves_type_for_plain_population():
+    pop = Population(OneMax, *DATA, size=3, seed=0)
+    clone = pop.clone_with(list(pop.individuals))
+    assert type(clone) is Population
+    assert clone.rng is pop.rng
