@@ -1,0 +1,88 @@
+"""Benchmark: CIFAR-10 Genetic-CNN fitness throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (fixed across rounds so BENCH_r{N}.json files are comparable):
+BASELINE config #2's shape — S=(3, 4, 5), 20-individual population,
+CIFAR-10-sized data (32×32×3, 10 classes; synthetic, since this machine has
+no network to fetch real CIFAR — the compute is identical), proxy-epoch
+fitness evaluation (kfold=2, 1 epoch/fold, batch 256, bfloat16) exactly as
+the GA's batched population path runs it (models/cnn.py).
+
+Metric: individuals evaluated / hour / chip, measured at steady state (the
+one-off XLA compile is excluded; it amortizes over a 50-generation search,
+and the mask-as-data design means it happens ONCE for the entire 8k+
+architecture search space).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md); the only
+quantitative anchor is the north star — 20×50 = 1000 evaluations on a
+v5e-32 in < 2 h ⇒ 15.625 individuals/hour/chip.  vs_baseline = value / 15.625.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_INDIVIDUALS_PER_HOUR_PER_CHIP = 1000 / 2.0 / 32  # north star, BASELINE.md
+
+
+def synthetic_cifar(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(size=(10, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    x = protos[y] + 0.5 * rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    return x, y
+
+
+def random_population(pop: int, seed: int):
+    from gentun_tpu.genes import genetic_cnn_genome
+
+    rng = np.random.default_rng(seed)
+    spec = genetic_cnn_genome((3, 4, 5))
+    return [spec.sample(rng) for _ in range(pop)]
+
+
+def main() -> None:
+    from gentun_tpu.models.cnn import GeneticCnnModel
+
+    pop = 20
+    config = dict(
+        nodes=(3, 4, 5),
+        kernels_per_layer=(32, 64, 128),
+        kfold=2,
+        epochs=(1,),
+        learning_rate=(0.01,),
+        batch_size=256,
+        dense_units=256,
+        compute_dtype="bfloat16",
+        seed=0,
+    )
+    x, y = synthetic_cifar(10_000)
+
+    # Warmup: same shapes/config → compiles and caches the one program.
+    GeneticCnnModel.cross_validate_population(x, y, random_population(pop, seed=1), **config)
+
+    t0 = time.monotonic()
+    accs = GeneticCnnModel.cross_validate_population(x, y, random_population(pop, seed=2), **config)
+    elapsed = time.monotonic() - t0
+
+    import jax
+
+    n_chips = jax.local_device_count()
+    value = pop / elapsed * 3600.0 / n_chips
+    assert np.isfinite(accs).all()
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_individuals_per_hour_per_chip",
+                "value": round(value, 2),
+                "unit": "individuals/hour/chip",
+                "vs_baseline": round(value / BASELINE_INDIVIDUALS_PER_HOUR_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""BASELINE config #5: deep Genetic CNN on CIFAR-100, S=(5,5,5), pop=50.
+
+Stresses the batched population path + mesh fan-out: 50 individuals with
+10+10+10 = 30 DAG bits each (2^30 search space), 100-way classification.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from gentun_tpu import GeneticCnnIndividual, Population, RussianRouletteGA
+from gentun_tpu.utils import EvalTimer
+from gentun_tpu.utils.datasets import load_cifar100
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generations", type=int, default=20)
+    ap.add_argument("--population", type=int, default=50)
+    ap.add_argument("--n-images", type=int, default=10_000)
+    args = ap.parse_args()
+
+    x, y, meta = load_cifar100(n=args.n_images)
+    print(f"data: {meta['source']} ({len(x)} images, 100 classes)")
+
+    pop = Population(
+        GeneticCnnIndividual,
+        x_train=x,
+        y_train=y,
+        size=args.population,
+        seed=0,
+        additional_parameters=dict(
+            nodes=(5, 5, 5),
+            kernels_per_layer=(64, 128, 256),
+            kfold=2,
+            epochs=(1,),
+            learning_rate=(0.01,),
+            batch_size=256,
+            dense_units=512,
+            compute_dtype="bfloat16",
+            seed=0,
+        ),
+    )
+    ga = RussianRouletteGA(pop, seed=0)
+    timer = EvalTimer()
+    with timer.measure(args.population * args.generations, label="deep-search"):
+        best = ga.run(args.generations)
+    print(f"best architecture: {best.get_genes()}")
+    print(f"best fitness: {best.get_fitness():.4f}")
+    print(f"throughput: {timer.summary()}")
+
+
+if __name__ == "__main__":
+    main()

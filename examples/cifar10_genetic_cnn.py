@@ -1,0 +1,68 @@
+"""BASELINE config #2: Genetic CNN on CIFAR-10, S=(3,4,5), 20 individuals.
+
+The north-star workload: the whole population trains as one vmapped,
+bfloat16 XLA program per generation (models/cnn.py), sharded over however
+many chips the host has (parallel/mesh.py).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from gentun_tpu import GeneticCnnIndividual, Population, RussianRouletteGA
+from gentun_tpu.utils import Checkpointer, EvalTimer
+from gentun_tpu.utils.datasets import load_cifar10
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generations", type=int, default=50)
+    ap.add_argument("--population", type=int, default=20)
+    ap.add_argument("--n-images", type=int, default=10_000)
+    ap.add_argument("--kfold", type=int, default=2)
+    ap.add_argument("--epochs", type=int, nargs="+", default=[1])
+    ap.add_argument("--lr", type=float, nargs="+", default=[0.01])
+    ap.add_argument("--checkpoint", default="")
+    args = ap.parse_args()
+
+    x, y, meta = load_cifar10(n=args.n_images)
+    print(f"data: {meta['source']} ({len(x)} images)")
+
+    pop = Population(
+        GeneticCnnIndividual,
+        x_train=x,
+        y_train=y,
+        size=args.population,
+        seed=0,
+        additional_parameters=dict(
+            nodes=(3, 4, 5),
+            kernels_per_layer=(32, 64, 128),
+            kfold=args.kfold,
+            epochs=tuple(args.epochs),
+            learning_rate=tuple(args.lr),
+            batch_size=256,
+            dense_units=256,
+            compute_dtype="bfloat16",
+            seed=0,
+        ),
+    )
+    # Roulette selection, per the Genetic-CNN paper the reference implements.
+    ga = RussianRouletteGA(pop, seed=0)
+    if args.checkpoint:
+        ckpt = Checkpointer(args.checkpoint)
+        if ckpt.resume(ga):
+            print(f"resumed at generation {ga.generation}")
+        ga.set_checkpointer(ckpt)
+    timer = EvalTimer()
+    with timer.measure(args.population * args.generations, label="search"):
+        best = ga.run(args.generations)
+    print(f"best architecture: {best.get_genes()}")
+    print(f"best fitness (mean val acc): {best.get_fitness():.4f}")
+    print(f"throughput: {timer.summary()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""BASELINE config #4: distributed search — master broker + TPU-VM workers.
+
+The reference launches a RabbitMQ server, N ``GentunClient`` worker
+processes, and a master script (gentun examples [PUB]; SURVEY.md §3.2-3.3).
+Here the broker is embedded in the master, so there are only two roles:
+
+    # on the master host (no training data needed):
+    python examples/distributed_search.py master --port 5672 --password s3cret
+
+    # on each TPU-VM worker host (owns its copy of the data):
+    python examples/distributed_search.py worker --host <master-ip> \
+        --port 5672 --password s3cret --capacity 8
+
+    # or an all-in-one local demo (master + 2 in-process workers):
+    python examples/distributed_search.py demo
+
+``--capacity 8`` lets one worker take 8 individuals at a time and train
+them as a single vmapped TPU program — the batched equivalent of the
+reference's one-individual-per-chip model.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import threading
+
+CNN_PARAMS = dict(
+    nodes=(3, 4, 5),
+    kernels_per_layer=(32, 64, 128),
+    kfold=2,
+    epochs=(1,),
+    learning_rate=(0.01,),
+    batch_size=256,
+    dense_units=256,
+    compute_dtype="bfloat16",
+    seed=0,
+)
+
+
+def run_master(args):
+    from gentun_tpu import GeneticAlgorithm, GeneticCnnIndividual
+    from gentun_tpu.distributed import DistributedPopulation
+
+    with DistributedPopulation(
+        GeneticCnnIndividual,
+        size=args.population,
+        seed=0,
+        additional_parameters=dict(CNN_PARAMS),
+        host="0.0.0.0",
+        port=args.port,
+        password=args.password or None,
+    ) as pop:
+        print(f"broker listening on port {pop.broker_address[1]}; waiting for workers")
+        best = GeneticAlgorithm(pop, seed=0).run(args.generations)
+        print(f"best architecture: {best.get_genes()}")
+        print(f"best fitness: {best.get_fitness():.4f}")
+
+
+def run_worker(args):
+    from gentun_tpu import GeneticCnnIndividual
+    from gentun_tpu.distributed import GentunClient
+    from gentun_tpu.utils.datasets import load_cifar10
+
+    x, y, meta = load_cifar10(n=args.n_images)
+    print(f"worker data: {meta['source']} ({len(x)} images)")
+    GentunClient(
+        GeneticCnnIndividual,
+        x,
+        y,
+        host=args.host,
+        port=args.port,
+        password=args.password or None,
+        capacity=args.capacity,
+    ).work()
+
+
+def run_demo(args):
+    """Master + 2 worker threads in one process (localhost, tiny shapes)."""
+    from gentun_tpu import GeneticAlgorithm, GeneticCnnIndividual
+    from gentun_tpu.distributed import DistributedPopulation, GentunClient
+    from gentun_tpu.utils.datasets import load_cifar10
+
+    params = dict(CNN_PARAMS)
+    params.update(kernels_per_layer=(8, 8, 8), dense_units=32, batch_size=64)
+    x, y, _ = load_cifar10(n=512)
+    with DistributedPopulation(
+        GeneticCnnIndividual, size=6, seed=0,
+        additional_parameters=params, port=0,
+    ) as pop:
+        _, port = pop.broker_address
+        stop = threading.Event()
+        for _ in range(2):
+            threading.Thread(
+                target=lambda: GentunClient(
+                    GeneticCnnIndividual, x, y, port=port, capacity=3
+                ).work(stop_event=stop),
+                daemon=True,
+            ).start()
+        try:
+            best = GeneticAlgorithm(pop, seed=0).run(args.generations)
+            print(f"demo best fitness: {best.get_fitness():.4f}")
+        finally:
+            stop.set()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="role", required=True)
+    m = sub.add_parser("master")
+    m.add_argument("--port", type=int, default=5672)
+    m.add_argument("--password", default="")
+    m.add_argument("--population", type=int, default=20)
+    m.add_argument("--generations", type=int, default=50)
+    w = sub.add_parser("worker")
+    w.add_argument("--host", default="127.0.0.1")
+    w.add_argument("--port", type=int, default=5672)
+    w.add_argument("--password", default="")
+    w.add_argument("--capacity", type=int, default=8)
+    w.add_argument("--n-images", type=int, default=10_000)
+    d = sub.add_parser("demo")
+    d.add_argument("--generations", type=int, default=2)
+    args = ap.parse_args()
+    {"master": run_master, "worker": run_worker, "demo": run_demo}[args.role](args)
+
+
+if __name__ == "__main__":
+    main()

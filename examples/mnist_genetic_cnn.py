@@ -1,0 +1,68 @@
+"""BASELINE config #1: Genetic CNN on MNIST, S=(3,5), 10 individuals.
+
+Single-process, CPU-runnable (pass --cpu to force the virtual CPU mesh).
+Mirrors the reference's MNIST example (gentun examples [PUB]); data loads
+offline (sklearn digits upscaled, or real MNIST via GENTUN_TPU_DATA).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from gentun_tpu import GeneticAlgorithm, GeneticCnnIndividual, Population
+from gentun_tpu.utils import Checkpointer
+from gentun_tpu.utils.datasets import load_mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generations", type=int, default=5)
+    ap.add_argument("--population", type=int, default=10)
+    ap.add_argument("--kfold", type=int, default=3)
+    ap.add_argument("--epochs", type=int, nargs="+", default=[3])
+    ap.add_argument("--lr", type=float, nargs="+", default=[0.01])
+    ap.add_argument("--checkpoint", default="")
+    ap.add_argument("--cpu", action="store_true", help="force CPU (no TPU touch)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    x, y, meta = load_mnist()
+    print(f"data: {meta['source']} ({len(x)} images)")
+
+    pop = Population(
+        GeneticCnnIndividual,
+        x_train=x,
+        y_train=y,
+        size=args.population,
+        seed=0,
+        additional_parameters=dict(
+            nodes=(3, 5),
+            kernels_per_layer=(20, 50),
+            kfold=args.kfold,
+            epochs=tuple(args.epochs),
+            learning_rate=tuple(args.lr),
+            batch_size=128,
+            dense_units=500,
+            seed=0,
+        ),
+    )
+    ga = GeneticAlgorithm(pop, seed=0)
+    if args.checkpoint:
+        ckpt = Checkpointer(args.checkpoint)
+        if ckpt.resume(ga):
+            print(f"resumed at generation {ga.generation}")
+        ga.set_checkpointer(ckpt)
+    best = ga.run(args.generations)
+    print(f"best architecture: {best.get_genes()}")
+    print(f"best fitness (mean val acc): {best.get_fitness():.4f}")
+
+
+if __name__ == "__main__":
+    main()

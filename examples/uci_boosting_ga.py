@@ -1,0 +1,45 @@
+"""BASELINE config #3: hyperparameter GA on UCI tables (non-TPU control path).
+
+The reference runs XGBoost on UCI adult/wine (gentun examples [PUB]); this
+environment has sklearn's real UCI wine and breast-cancer tables bundled, so
+the control path runs on genuine data with HistGradientBoosting
+(models/boosting.py — xgboost is not installed, SURVEY.md §2.1).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from gentun_tpu import BoostingIndividual, GeneticAlgorithm, Population
+from gentun_tpu.utils.datasets import load_uci_binary, load_uci_wine
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", choices=["wine", "binary"], default="wine")
+    ap.add_argument("--generations", type=int, default=10)
+    ap.add_argument("--population", type=int, default=20)
+    ap.add_argument("--kfold", type=int, default=5)
+    args = ap.parse_args()
+
+    x, y, meta = load_uci_wine() if args.dataset == "wine" else load_uci_binary()
+    print(f"data: {meta['source']} ({x.shape[0]} rows, {x.shape[1]} features)")
+
+    pop = Population(
+        BoostingIndividual,
+        x_train=x,
+        y_train=y,
+        size=args.population,
+        seed=0,
+        additional_parameters={"kfold": args.kfold, "seed": 0},
+    )
+    best = GeneticAlgorithm(pop, seed=0).run(args.generations)
+    print(f"best hyperparameters: {best.get_genes()}")
+    print(f"best fitness (CV accuracy): {best.get_fitness():.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,7 @@ import flax.linen as nn
 import optax
 
 from ..ops.dag import stack_genome_masks
+from ..parallel.mesh import auto_mesh, pad_population, shard_cv_args
 from .generic import GentunModel
 
 __all__ = ["MaskedGeneticCnn", "GeneticCnnModel"]
@@ -254,6 +255,7 @@ class GeneticCnnModel(GentunModel):
         nesterov: bool = False,
         compute_dtype: str = "bfloat16",
         seed: int = 0,
+        mesh="auto",
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -271,6 +273,7 @@ class GeneticCnnModel(GentunModel):
             nesterov=bool(nesterov),
             compute_dtype=str(compute_dtype),
             seed=int(seed),
+            mesh=mesh,
         )
 
     def cross_validate(self) -> float:
@@ -297,9 +300,17 @@ class GeneticCnnModel(GentunModel):
         cfg = _normalize_config(x_train, y_train, config)
         x, y = _prepare_data(x_train, y_train, cfg)
         nodes = cfg["nodes"]
-        pop = len(genomes)
-        if pop == 0:
+        if len(genomes) == 0:
             return np.zeros((0,), dtype=np.float32)
+
+        # Multi-chip: shard the population axis over the mesh (and the train
+        # batch over its data axis).  Pad so the pop axis divides evenly;
+        # results are sliced back to the caller's length.
+        mesh = cfg["mesh"]
+        if mesh == "auto":
+            mesh = auto_mesh(pop_size=len(genomes))
+        genomes, n_real = pad_population(genomes, mesh.shape["pop"] if mesh else 1)
+        pop = len(genomes)
 
         stacked = [
             {k: jnp.asarray(v) for k, v in stage.items()}
@@ -369,19 +380,32 @@ class GeneticCnnModel(GentunModel):
                 model, stacked, cfg["input_shape"], pop, cfg["seed"] + f
             )
             fold_keys = jax.random.split(jax.random.fold_in(base_key, f), pop)
+            arrays = dict(
+                x_tr=jnp.asarray(x[tr_idx]),
+                y_tr=jnp.asarray(y[tr_idx]),
+                x_val=jnp.asarray(x[val_idx_padded]),
+                y_val=jnp.asarray(y[val_idx_padded]),
+                val_weight=jnp.asarray(val_weight),
+                batch_idx=jnp.asarray(batch_idx),
+            )
+            fold_masks = stacked
+            if mesh is not None:
+                params, fold_masks, fold_keys, arrays = shard_cv_args(
+                    mesh, params, stacked, fold_keys, arrays
+                )
             acc, _ = fn(
                 params,
-                stacked,
-                jnp.asarray(x[tr_idx]),
-                jnp.asarray(y[tr_idx]),
-                jnp.asarray(x[val_idx_padded]),
-                jnp.asarray(y[val_idx_padded]),
-                jnp.asarray(val_weight),
-                jnp.asarray(batch_idx),
+                fold_masks,
+                arrays["x_tr"],
+                arrays["y_tr"],
+                arrays["x_val"],
+                arrays["y_val"],
+                arrays["val_weight"],
+                arrays["batch_idx"],
                 fold_keys,
             )
             accs[f] = np.asarray(acc)
-        return accs.mean(axis=0)
+        return accs.mean(axis=0)[:n_real]
 
 
 def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any]:
@@ -401,6 +425,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         nesterov=False,
         compute_dtype="bfloat16",
         seed=0,
+        mesh="auto",
     )
     unknown = set(config) - set(defaults)
     if unknown:

@@ -1,0 +1,10 @@
+"""Multi-chip parallelism: mesh construction and input sharding.
+
+SURVEY.md §2.2: the reference has population-task parallelism only; the
+rebuild adds per-worker data/population parallelism over a
+``jax.sharding.Mesh``, with XLA inserting all collectives (GSPMD).
+"""
+
+from .mesh import auto_mesh, mesh_axis_sizes, pad_population, shard_cv_args
+
+__all__ = ["auto_mesh", "mesh_axis_sizes", "pad_population", "shard_cv_args"]

@@ -1,0 +1,132 @@
+"""Device-mesh helpers: population × data parallelism for fitness training.
+
+The reference's only parallelism is population-level task parallelism over
+RabbitMQ workers, each training on a single GPU (SURVEY.md §2.2).  The
+rebuild keeps that control-plane parallelism (``distributed/``) and adds the
+one new axis the north star asks for: **multi-chip scaling inside a worker**
+over a ``jax.sharding.Mesh``.
+
+Two named axes:
+
+- ``pop`` — shards the vmapped population axis of the batched trainer
+  (``models/cnn.py``).  Individuals are independent, so this axis needs
+  ZERO collectives: pure scale-out, the GA's dominant regime.
+- ``data`` — shards the per-step training batch.  Params stay replicated
+  along ``data``; XLA's sharding propagation inserts the gradient
+  all-reduce over ICI automatically (GSPMD), which is the entire
+  data-parallel implementation — no hand-written collectives, per the
+  scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+  collectives.
+
+No function here changes the compiled computation: multi-chip execution is
+driven purely by the shardings of the input arrays (``shard_cv_args``),
+which is what keeps the single-chip and 32-chip paths one and the same
+jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["auto_mesh", "pad_population", "shard_cv_args", "mesh_axis_sizes"]
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is <= cap (>=1)."""
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def auto_mesh(
+    pop_size: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    pop_axis: Optional[int] = None,
+    data_axis: Optional[int] = None,
+) -> Optional[Mesh]:
+    """Factor the available devices into a ``(pop, data)`` mesh.
+
+    Preference order: put devices on the communication-free ``pop`` axis
+    (up to ``pop_size``); spill the rest onto ``data``.  Returns ``None``
+    on a single device — the caller then skips sharding entirely, so the
+    one-chip path stays annotation-free.
+
+    Explicit ``pop_axis``/``data_axis`` override the heuristic (their
+    product must equal the device count).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n == 1:
+        return None
+    if pop_axis is not None or data_axis is not None:
+        pop_axis = pop_axis or (n // (data_axis or 1))
+        data_axis = data_axis or (n // pop_axis)
+        if pop_axis * data_axis != n:
+            raise ValueError(f"pop_axis*data_axis = {pop_axis}*{data_axis} != {n} devices")
+    else:
+        cap = n if pop_size is None else max(1, pop_size)
+        pop_axis = _largest_divisor_leq(n, cap)
+        data_axis = n // pop_axis
+    mesh_devices = np.asarray(devices).reshape(pop_axis, data_axis)
+    return Mesh(mesh_devices, axis_names=("pop", "data"))
+
+
+def mesh_axis_sizes(mesh: Optional[Mesh]) -> Tuple[int, int]:
+    if mesh is None:
+        return 1, 1
+    return mesh.shape["pop"], mesh.shape["data"]
+
+
+def pad_population(genomes: Sequence[Any], multiple: int) -> Tuple[List[Any], int]:
+    """Pad the genome list to a multiple of the pop-axis size.
+
+    Padding repeats the last genome; callers slice the results back to the
+    original length.  Returns (padded_list, original_length).
+    """
+    n = len(genomes)
+    if multiple <= 1 or n % multiple == 0:
+        return list(genomes), n
+    padded = list(genomes) + [genomes[-1]] * (multiple - n % multiple)
+    return padded, n
+
+
+def shard_cv_args(
+    mesh: Mesh,
+    params,
+    masks_stacked: List[Dict[str, Any]],
+    fold_keys,
+    arrays: Dict[str, Any],
+):
+    """Place the batched-CV inputs onto the mesh.
+
+    - ``params`` / ``masks`` / per-individual ``fold_keys``: leading axis
+      over ``pop`` (replicated along ``data``);
+    - ``batch_idx (steps, batch)``: batch dim over ``data`` — this is what
+      makes each training step data-parallel, because the gathers that
+      consume these indices inherit the sharding and the loss/grad reduce
+      over the batch becomes an ICI all-reduce;
+    - everything else (the fold's train/val arrays, val weights):
+      replicated.  Workers own their whole data shard by design (SURVEY.md
+      §1), so replication here is within one worker's slice only.
+    """
+    pop_spec = NamedSharding(mesh, P("pop"))
+    repl = NamedSharding(mesh, P())
+    batch_spec = NamedSharding(mesh, P(None, "data"))
+
+    params = jax.device_put(params, pop_spec)
+    masks_stacked = [
+        {k: jax.device_put(v, pop_spec) for k, v in stage.items()}
+        for stage in masks_stacked
+    ]
+    fold_keys = jax.device_put(fold_keys, pop_spec)
+    out = dict(arrays)
+    for name in ("x_tr", "y_tr", "x_val", "y_val", "val_weight"):
+        out[name] = jax.device_put(out[name], repl)
+    out["batch_idx"] = jax.device_put(out["batch_idx"], batch_spec)
+    return params, masks_stacked, fold_keys, out

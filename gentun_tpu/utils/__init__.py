@@ -1,0 +1,7 @@
+"""Auxiliary subsystems the reference lacks (SURVEY.md §5): checkpoint /
+resume, offline-safe dataset loaders, tracing/metrics."""
+
+from .checkpoint import Checkpointer, load_checkpoint
+from .profiling import EvalTimer, trace
+
+__all__ = ["Checkpointer", "load_checkpoint", "EvalTimer", "trace"]

@@ -1,0 +1,88 @@
+"""Generation-boundary checkpoint/resume.
+
+The reference has NO checkpointing: the population lives in master memory
+and a crash loses the whole search (SURVEY.md §5 "Checkpoint / resume").
+The rebuild adds the subsystem the survey prescribes: at every generation
+boundary, persist {genes, fitness, RNG state, history} as JSON — tiny,
+human-readable, and enough to resume a search bit-exactly (the GA consumes
+randomness only from its own generator, whose state is saved).
+
+Model weights are deliberately NOT checkpointed: fitness evaluation is
+stateless by design (every individual trains from scratch), so there is no
+model state worth resuming — which is also why JSON suffices over orbax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+__all__ = ["Checkpointer", "load_checkpoint"]
+
+
+def _to_jsonable(obj: Any) -> Any:
+    """numpy scalars/arrays → plain Python, recursively (RNG state has them)."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return _to_jsonable(obj.tolist())
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+class Checkpointer:
+    """Atomic JSON checkpoints, attached to a GA via ``set_checkpointer``.
+
+    ``GeneticAlgorithm.evolve_population`` calls :meth:`save` after every
+    generation; :meth:`resume` restores an algorithm to the last saved
+    state.  Writes are tmp-file + rename, so a crash mid-write leaves the
+    previous checkpoint intact.
+    """
+
+    def __init__(self, path: str, keep_history: bool = True):
+        self.path = str(path)
+        self.keep_history = keep_history
+
+    def save(self, algorithm) -> None:
+        state = algorithm.state_dict()
+        if not self.keep_history:
+            state["history"] = state["history"][-1:]
+        payload = json.dumps(_to_jsonable(state), separators=(",", ":"))
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt-", suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path) as f:
+            return json.load(f)
+
+    def resume(self, algorithm) -> bool:
+        """Restore ``algorithm`` from the checkpoint; True if one existed."""
+        state = self.load()
+        if state is None:
+            return False
+        algorithm.load_state_dict(state)
+        return True
+
+
+def load_checkpoint(path: str) -> Optional[Dict[str, Any]]:
+    return Checkpointer(path).load()

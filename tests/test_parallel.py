@@ -1,0 +1,109 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4).
+
+conftest pins jax to cpu with xla_force_host_platform_device_count=8, so
+``jax.devices()`` is 8 virtual devices and every sharding path executes for
+real (XLA partitions + collectives), just on CPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gentun_tpu.models.cnn import GeneticCnnModel
+from gentun_tpu.parallel.mesh import auto_mesh, mesh_axis_sizes, pad_population
+
+FAST = dict(
+    nodes=(3,),
+    kernels_per_layer=(8,),
+    kfold=2,
+    epochs=(2,),
+    learning_rate=(0.05,),
+    batch_size=32,
+    dense_units=32,
+    compute_dtype="float32",
+    seed=0,
+)
+
+
+@pytest.fixture(scope="module")
+def separable_data():
+    rng = np.random.default_rng(0)
+    protos = rng.normal(size=(4, 8, 8, 1)).astype(np.float32)
+    y = rng.integers(0, 4, size=192).astype(np.int32)
+    x = protos[y] + 0.3 * rng.normal(size=(192, 8, 8, 1)).astype(np.float32)
+    return x, y
+
+
+class TestMeshConstruction:
+    def test_eight_devices_available(self):
+        assert jax.device_count() == 8
+
+    def test_auto_mesh_prefers_pop_axis(self):
+        mesh = auto_mesh(pop_size=16)
+        assert mesh_axis_sizes(mesh) == (8, 1)
+
+    def test_auto_mesh_spills_to_data_axis(self):
+        # pop=3: largest divisor of 8 that is <= 3 is 2 → (2, 4)
+        mesh = auto_mesh(pop_size=3)
+        assert mesh_axis_sizes(mesh) == (2, 4)
+
+    def test_auto_mesh_single_individual(self):
+        mesh = auto_mesh(pop_size=1)
+        assert mesh_axis_sizes(mesh) == (1, 8)  # pure data parallelism
+
+    def test_explicit_axes(self):
+        mesh = auto_mesh(pop_axis=4, data_axis=2)
+        assert mesh_axis_sizes(mesh) == (4, 2)
+        with pytest.raises(ValueError):
+            auto_mesh(pop_axis=3, data_axis=2)
+
+    def test_single_device_returns_none(self):
+        assert auto_mesh(pop_size=4, devices=jax.devices()[:1]) is None
+
+    def test_pad_population(self):
+        genomes = [{"S_1": (0, 0, 0)}, {"S_1": (1, 0, 1)}, {"S_1": (1, 1, 1)}]
+        padded, n = pad_population(genomes, 4)
+        assert n == 3 and len(padded) == 4
+        assert padded[3] == genomes[2]
+        same, n2 = pad_population(genomes, 3)
+        assert n2 == 3 and same == genomes
+
+
+class TestShardedTraining:
+    def test_sharded_matches_unsharded(self, separable_data):
+        """The mesh changes placement, not math: same seeds → same accs."""
+        x, y = separable_data
+        genomes = [
+            {"S_1": (0, 0, 0)},
+            {"S_1": (1, 0, 1)},
+            {"S_1": (1, 1, 1)},
+            {"S_1": (0, 1, 1)},
+        ]
+        cfg = dict(FAST)
+        cfg["mesh"] = None
+        ref = GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
+        cfg["mesh"] = auto_mesh(pop_size=4)  # (4, 2): both axes exercised
+        shd = GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
+        assert shd.shape == (4,)
+        np.testing.assert_allclose(ref, shd, atol=0.06)  # CPU reduce-order jitter
+        assert (shd > 0.4).all()
+
+    def test_population_padding_roundtrip(self, separable_data):
+        """pop=3 on an (8,1) mesh: padded to 8, sliced back to 3."""
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (0, 0, 0)}, {"S_1": (1, 1, 1)}]
+        cfg = dict(FAST)
+        cfg["mesh"] = auto_mesh(pop_axis=8, data_axis=1)
+        accs = GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
+        assert accs.shape == (3,)
+        assert (accs > 0.4).all()
+
+    def test_auto_mesh_is_default(self, separable_data):
+        """mesh='auto' engages the 8-device mesh without explicit config."""
+        x, y = separable_data
+        accs = GeneticCnnModel.cross_validate_population(
+            x, y, [{"S_1": (1, 0, 1)}, {"S_1": (1, 1, 0)}], **FAST
+        )
+        assert accs.shape == (2,)
+        assert (accs > 0.4).all()

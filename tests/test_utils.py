@@ -1,0 +1,130 @@
+"""Tests for checkpoint/resume, dataset loaders, and timers (utils/)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome
+from gentun_tpu.utils import Checkpointer, EvalTimer
+from gentun_tpu.utils.datasets import (
+    load_cifar10,
+    load_cifar100,
+    load_mnist,
+    load_uci_binary,
+    load_uci_wine,
+    synthetic_images,
+)
+
+
+class OneMax(Individual):
+    def build_spec(self, **p):
+        return genetic_cnn_genome((4, 4))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1), np.zeros(1))
+
+
+class TestCheckpoint:
+    def test_save_creates_valid_json(self, tmp_path):
+        path = str(tmp_path / "ckpt.json")
+        ga = GeneticAlgorithm(Population(OneMax, *DATA, size=4, seed=0), seed=0)
+        ga.set_checkpointer(Checkpointer(path))
+        ga.run(2)
+        with open(path) as f:
+            state = json.load(f)
+        assert state["generation"] == 2
+        assert len(state["population"]["individuals"]) == 4
+
+    def test_resume_is_bit_exact(self, tmp_path):
+        """Interrupted-and-resumed search == uninterrupted search."""
+        path = str(tmp_path / "ckpt.json")
+        # uninterrupted: 5 generations straight
+        ga_full = GeneticAlgorithm(Population(OneMax, *DATA, size=6, seed=42), seed=7)
+        ga_full.run(5)
+
+        # interrupted: 2 generations, "crash", resume, 3 more
+        ga_a = GeneticAlgorithm(Population(OneMax, *DATA, size=6, seed=42), seed=7)
+        ga_a.set_checkpointer(Checkpointer(path))
+        ga_a.run(2)
+        del ga_a
+
+        ga_b = GeneticAlgorithm(Population(OneMax, *DATA, size=6, seed=0), seed=0)
+        assert Checkpointer(path).resume(ga_b)
+        assert ga_b.generation == 2
+        ga_b.run(3)
+
+        full = [(ind.get_genes(), ind.get_fitness()) for ind in ga_full.population]
+        resumed = [(ind.get_genes(), ind.get_fitness()) for ind in ga_b.population]
+        assert full == resumed
+
+    def test_resume_without_checkpoint_returns_false(self, tmp_path):
+        ga = GeneticAlgorithm(Population(OneMax, *DATA, size=2, seed=0), seed=0)
+        assert not Checkpointer(str(tmp_path / "missing.json")).resume(ga)
+
+    def test_atomic_write_leaves_no_temp_files(self, tmp_path):
+        path = str(tmp_path / "ckpt.json")
+        ga = GeneticAlgorithm(Population(OneMax, *DATA, size=2, seed=0), seed=0)
+        ckpt = Checkpointer(path)
+        ckpt.save(ga)
+        ckpt.save(ga)  # overwrite path
+        leftovers = [f for f in os.listdir(tmp_path) if f.startswith(".ckpt-")]
+        assert leftovers == []
+
+
+class TestDatasets:
+    def test_mnist_shape_and_real_source(self):
+        x, y, meta = load_mnist()
+        assert x.shape[1:] == (28, 28, 1)
+        assert x.dtype == np.float32 and y.dtype == np.int32
+        assert set(np.unique(y)) <= set(range(10))
+        assert not meta["synthetic"]  # sklearn digits are real data
+
+    def test_cifar_loaders_shapes(self):
+        x10, y10, m10 = load_cifar10(n=128)
+        assert x10.shape == (128, 32, 32, 3) and m10["synthetic"]
+        x100, y100, m100 = load_cifar100(n=256)
+        assert x100.shape == (256, 32, 32, 3)
+        assert y100.max() < 100
+
+    def test_npz_override(self, tmp_path, monkeypatch):
+        rng = np.random.default_rng(0)
+        np.savez(
+            tmp_path / "cifar10.npz",
+            x=rng.integers(0, 255, size=(16, 32, 32, 3)).astype(np.uint8),
+            y=rng.integers(0, 10, size=16),
+        )
+        monkeypatch.setenv("GENTUN_TPU_DATA", str(tmp_path))
+        x, y, meta = load_cifar10(n=16)
+        assert not meta["synthetic"]
+        assert x.max() <= 1.0  # 0-255 normalised
+
+    def test_uci_tables_are_real(self):
+        x, y, meta = load_uci_wine()
+        assert x.shape[0] == y.shape[0] == 178  # the actual UCI wine size
+        assert not meta["synthetic"]
+        xb, yb, mb = load_uci_binary()
+        assert set(np.unique(yb)) == {0, 1}
+        assert not mb["synthetic"]
+
+    def test_synthetic_is_deterministic(self):
+        a = synthetic_images(32, (8, 8, 1), 4, seed=5)
+        b = synthetic_images(32, (8, 8, 1), 4, seed=5)
+        np.testing.assert_array_equal(a[0], b[0])
+
+
+class TestEvalTimer:
+    def test_records_and_summary(self):
+        t = EvalTimer(n_chips=2)
+        with t.measure(10, label="gen0"):
+            pass
+        with t.measure(6, label="gen1"):
+            pass
+        assert t.total_individuals == 16
+        s = t.summary()
+        assert s["individuals"] == 16
+        assert s["individuals_per_hour_per_chip"] > 0
