@@ -29,6 +29,11 @@ TPU-first architecture (NOT how the reference does it — SURVEY.md §7
 - Static shapes everywhere: fold sizes are equalised by trimming, train
   batches are a precomputed ``(steps, batch)`` index array consumed by
   ``lax.scan``, eval uses padded index batches with 0/1 weights.
+- **The k-fold axis is batched too** (SURVEY.md §7 "hard parts" #3): the
+  dataset lives on device ONCE and folds are expressed as index arrays, so
+  all ``kfold`` folds of all ``P`` genomes train inside a single XLA
+  program — a ``vmap(fold) ∘ vmap(pop)`` nest whose matmuls are
+  ``kfold·P``-wide.  No per-fold host round-trips, no per-fold transfers.
 """
 
 from __future__ import annotations
@@ -45,6 +50,7 @@ import optax
 
 from ..ops.dag import stack_genome_masks
 from ..parallel.mesh import auto_mesh, pad_population, shard_cv_args
+from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
 from .generic import GentunModel
 
 __all__ = ["MaskedGeneticCnn", "GeneticCnnModel"]
@@ -142,6 +148,7 @@ def _population_cv_fn(
     batch_size: int,
     n_train: int,
     n_val_padded: int,
+    fold_parallel: bool,
 ):
     model = MaskedGeneticCnn(
         nodes=nodes,
@@ -174,15 +181,21 @@ def _population_cv_fn(
         )
         return optax.softmax_cross_entropy_with_integer_labels(logits, batch_y).mean()
 
-    def train_one(params, masks, x_tr, y_tr, x_val, y_val, val_weight, batch_idx, rng):
-        """Full train + eval for ONE individual (vmapped below)."""
+    def train_one(params, masks, x_full, y_full, val_idx, val_weight, batch_idx, rng):
+        """Full train + eval for ONE (fold, individual) pair (double-vmapped).
+
+        The dataset arrives whole (``x_full``); the fold is expressed purely
+        as index arrays (``batch_idx`` gathers train batches, ``val_idx``
+        gathers the held-out fold), so every fold shares the device-resident
+        data and all folds train concurrently.
+        """
         opt_state = tx.init(params)
 
         def step(carry, idx_b):
             params, opt_state, rng = carry
             rng, dropout_rng = jax.random.split(rng)
-            batch_x = jnp.take(x_tr, idx_b, axis=0)
-            batch_y = jnp.take(y_tr, idx_b, axis=0)
+            batch_x = jnp.take(x_full, idx_b, axis=0)
+            batch_y = jnp.take(y_full, idx_b, axis=0)
             loss, grads = jax.value_and_grad(loss_fn)(params, masks, batch_x, batch_y, dropout_rng)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -191,9 +204,10 @@ def _population_cv_fn(
         (params, _, _), losses = jax.lax.scan(step, (params, opt_state, rng), batch_idx)
 
         def eval_batch(correct, start):
-            xb = jax.lax.dynamic_slice_in_dim(x_val, start, batch_size, axis=0)
-            yb = jax.lax.dynamic_slice_in_dim(y_val, start, batch_size, axis=0)
+            idx_b = jax.lax.dynamic_slice_in_dim(val_idx, start, batch_size, axis=0)
             wb = jax.lax.dynamic_slice_in_dim(val_weight, start, batch_size, axis=0)
+            xb = jnp.take(x_full, idx_b, axis=0)
+            yb = jnp.take(y_full, idx_b, axis=0)
             logits = model.apply({"params": params}, xb, masks, train=False)
             hits = (jnp.argmax(logits, axis=-1) == yb).astype(jnp.float32)
             return correct + jnp.sum(hits * wb), None
@@ -203,20 +217,50 @@ def _population_cv_fn(
         acc = correct / jnp.maximum(val_weight.sum(), 1.0)
         return acc, losses[-1]
 
-    # Population axis: params, masks, rng are per-individual; data is shared.
-    vmapped = jax.vmap(train_one, in_axes=(0, 0, None, None, None, None, None, None, 0))
-    return jax.jit(vmapped)
+    # Inner vmap — population axis: params, masks, rng per-individual; the
+    # dataset and this fold's index arrays are shared across the population.
+    over_pop = jax.vmap(train_one, in_axes=(0, 0, None, None, None, None, None, 0))
+
+    # Outer fold axis — params, rng, and the fold index arrays are per-fold;
+    # masks (the genomes) and the dataset are shared across folds.  Two
+    # strategies, both single-program with the dataset resident on device:
+    #
+    # - ``vmap``: all folds train concurrently.  Maximum parallelism, but the
+    #   live working set is kfold× larger — best when pop×kfold is small.
+    # - ``map`` (lax.map = scan): folds run sequentially *inside* the program.
+    #   The population axis already saturates the MXU for real population
+    #   sizes, and the smaller working set avoids HBM spills.  Default.
+    if fold_parallel:
+        over_folds = jax.vmap(over_pop, in_axes=(0, None, None, None, 0, 0, 0, 0))
+    else:
+
+        def over_folds(params, masks, x_full, y_full, val_idx, val_weight, batch_idx, rng):
+            return jax.lax.map(
+                lambda per_fold: over_pop(
+                    per_fold[0], masks, x_full, y_full, per_fold[1], per_fold[2], per_fold[3], per_fold[4]
+                ),
+                (params, val_idx, val_weight, batch_idx, rng),
+            )
+
+    return jax.jit(over_folds)
 
 
-def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, seed):
-    """Per-individual parameter init (vmapped so shapes carry a P axis)."""
-    keys = jax.random.split(jax.random.PRNGKey(seed), pop_size)
+def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed):
+    """Per-(fold, individual) parameter init → shapes carry a (kfold, P) prefix.
+
+    Each fold trains from an independent init (seed folded per fold), matching
+    the reference's fresh model per CV fold.
+    """
+    keys = jnp.stack(
+        [jax.random.split(jax.random.PRNGKey(seed + f), pop_size) for f in range(kfold)]
+    )
     dummy = jnp.zeros((1, *input_shape), dtype=jnp.float32)
 
     def init_one(key, masks):
         return model.init({"params": key}, dummy, masks, train=False)["params"]
 
-    return jax.vmap(init_one, in_axes=(0, 0))(keys, masks_stacked)
+    over_pop = jax.vmap(init_one, in_axes=(0, 0))
+    return jax.vmap(over_pop, in_axes=(0, None))(keys, masks_stacked)
 
 
 class GeneticCnnModel(GentunModel):
@@ -256,6 +300,8 @@ class GeneticCnnModel(GentunModel):
         compute_dtype: str = "bfloat16",
         seed: int = 0,
         mesh="auto",
+        cache_dir: Optional[str] = None,
+        fold_parallel: bool = False,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -274,6 +320,8 @@ class GeneticCnnModel(GentunModel):
             compute_dtype=str(compute_dtype),
             seed=int(seed),
             mesh=mesh,
+            cache_dir=cache_dir,
+            fold_parallel=bool(fold_parallel),
         )
 
     def cross_validate(self) -> float:
@@ -302,6 +350,12 @@ class GeneticCnnModel(GentunModel):
         nodes = cfg["nodes"]
         if len(genomes) == 0:
             return np.zeros((0,), dtype=np.float32)
+
+        # Persistent XLA compilation cache: a resumed/restarted search reuses
+        # the compiled program from disk (SURVEY.md §7 hard part #1).
+        cache_dir = cfg["cache_dir"] or default_cache_dir()
+        if cache_dir:
+            enable_compilation_cache(cache_dir)
 
         # Multi-chip: shard the population axis over the mesh (and the train
         # batch over its data axis).  Pad so the pop axis divides evenly;
@@ -335,13 +389,17 @@ class GeneticCnnModel(GentunModel):
         n_use = fold_size * kfold  # equal folds → one compiled shape
         rng = np.random.default_rng(cfg["seed"])
         perm = rng.permutation(n)[:n_use]
-        folds = perm.reshape(kfold, fold_size)
+        # The device-resident dataset is x[perm]; folds are consecutive
+        # position blocks within it, so every index array below addresses
+        # x_full/y_full directly.
+        folds = np.arange(n_use, dtype=np.int32).reshape(kfold, fold_size)
 
         batch_size = min(cfg["batch_size"], n_use - fold_size)
         n_tr = n_use - fold_size
         steps_per_epoch = max(n_tr // batch_size, 1)
         total_steps = sum(cfg["epochs"]) * steps_per_epoch
         n_val_padded = int(np.ceil(fold_size / batch_size)) * batch_size
+        pad = n_val_padded - fold_size
 
         fn = _population_cv_fn(
             nodes,
@@ -357,55 +415,56 @@ class GeneticCnnModel(GentunModel):
             batch_size,
             n_tr,
             n_val_padded,
+            bool(cfg["fold_parallel"]),
         )
 
-        accs = np.zeros((kfold, pop), dtype=np.float32)
-        base_key = jax.random.PRNGKey(cfg["seed"])
+        # Per-fold index arrays (host-side numpy, tiny): the fold IS its
+        # indices.  batch_idx holds *global* dataset indices, so the compiled
+        # program gathers straight from the one device-resident copy of x.
+        batch_idx = np.zeros((kfold, total_steps, batch_size), dtype=np.int32)
+        val_idx = np.zeros((kfold, n_val_padded), dtype=np.int32)
+        val_weight = np.zeros((kfold, n_val_padded), dtype=np.float32)
         for f in range(kfold):
-            val_idx = folds[f]
             tr_idx = np.concatenate([folds[g] for g in range(kfold) if g != f])
-            # Per-epoch shuffled batch indices, host-side: (steps, batch).
             order = np.concatenate(
                 [rng.permutation(n_tr) for _ in range(sum(cfg["epochs"]))]
             )[: total_steps * batch_size]
-            batch_idx = order.reshape(total_steps, batch_size)
-
-            pad = n_val_padded - fold_size
-            val_idx_padded = np.concatenate([val_idx, np.full(pad, val_idx[0])])
-            val_weight = np.concatenate(
+            batch_idx[f] = tr_idx[order].reshape(total_steps, batch_size)
+            val_idx[f] = np.concatenate([folds[f], np.full(pad, folds[f][0])])
+            val_weight[f] = np.concatenate(
                 [np.ones(fold_size, np.float32), np.zeros(pad, np.float32)]
             )
 
-            params = _init_population_params(
-                model, stacked, cfg["input_shape"], pop, cfg["seed"] + f
+        params = _init_population_params(
+            model, stacked, cfg["input_shape"], pop, kfold, cfg["seed"]
+        )
+        base_key = jax.random.PRNGKey(cfg["seed"])
+        fold_keys = jnp.stack(
+            [jax.random.split(jax.random.fold_in(base_key, f), pop) for f in range(kfold)]
+        )
+        arrays = dict(
+            x_full=jnp.asarray(x[perm]),
+            y_full=jnp.asarray(y[perm]),
+            val_idx=jnp.asarray(val_idx),
+            val_weight=jnp.asarray(val_weight),
+            batch_idx=jnp.asarray(batch_idx),
+        )
+        masks = stacked
+        if mesh is not None:
+            params, masks, fold_keys, arrays = shard_cv_args(
+                mesh, params, stacked, fold_keys, arrays
             )
-            fold_keys = jax.random.split(jax.random.fold_in(base_key, f), pop)
-            arrays = dict(
-                x_tr=jnp.asarray(x[tr_idx]),
-                y_tr=jnp.asarray(y[tr_idx]),
-                x_val=jnp.asarray(x[val_idx_padded]),
-                y_val=jnp.asarray(y[val_idx_padded]),
-                val_weight=jnp.asarray(val_weight),
-                batch_idx=jnp.asarray(batch_idx),
-            )
-            fold_masks = stacked
-            if mesh is not None:
-                params, fold_masks, fold_keys, arrays = shard_cv_args(
-                    mesh, params, stacked, fold_keys, arrays
-                )
-            acc, _ = fn(
-                params,
-                fold_masks,
-                arrays["x_tr"],
-                arrays["y_tr"],
-                arrays["x_val"],
-                arrays["y_val"],
-                arrays["val_weight"],
-                arrays["batch_idx"],
-                fold_keys,
-            )
-            accs[f] = np.asarray(acc)
-        return accs.mean(axis=0)[:n_real]
+        acc, _ = fn(
+            params,
+            masks,
+            arrays["x_full"],
+            arrays["y_full"],
+            arrays["val_idx"],
+            arrays["val_weight"],
+            arrays["batch_idx"],
+            fold_keys,
+        )
+        return np.asarray(acc, dtype=np.float32).mean(axis=0)[:n_real]
 
 
 def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any]:
@@ -426,6 +485,8 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         compute_dtype="bfloat16",
         seed=0,
         mesh="auto",
+        cache_dir=None,
+        fold_parallel=False,
     )
     unknown = set(config) - set(defaults)
     if unknown:

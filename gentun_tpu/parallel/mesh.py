@@ -105,28 +105,35 @@ def shard_cv_args(
 ):
     """Place the batched-CV inputs onto the mesh.
 
-    - ``params`` / ``masks`` / per-individual ``fold_keys``: leading axis
-      over ``pop`` (replicated along ``data``);
-    - ``batch_idx (steps, batch)``: batch dim over ``data`` — this is what
-      makes each training step data-parallel, because the gathers that
-      consume these indices inherit the sharding and the loss/grad reduce
-      over the batch becomes an ICI all-reduce;
-    - everything else (the fold's train/val arrays, val weights):
-      replicated.  Workers own their whole data shard by design (SURVEY.md
-      §1), so replication here is within one worker's slice only.
+    Array layouts after the fold-batched redesign (``models/cnn.py``): the
+    fold axis leads ``params (kfold, P, ...)``, ``fold_keys (kfold, P, 2)``,
+    ``batch_idx (kfold, steps, batch)``, ``val_idx``/``val_weight
+    (kfold, n_val_padded)``; masks keep their ``(P, ...)`` leading axis.
+
+    - ``params`` / ``fold_keys``: ``pop`` shards axis 1 (the population);
+      the fold axis and ``data`` are replicated;
+    - ``masks``: ``pop`` shards axis 0;
+    - ``batch_idx``: batch dim (last) over ``data`` — this is what makes
+      each training step data-parallel, because the gathers that consume
+      these indices inherit the sharding and the loss/grad reduce over the
+      batch becomes an ICI all-reduce;
+    - the dataset and val index/weight arrays: replicated.  Workers own
+      their whole data shard by design (SURVEY.md §1), so replication here
+      is within one worker's slice only.
     """
     pop_spec = NamedSharding(mesh, P("pop"))
+    fold_pop_spec = NamedSharding(mesh, P(None, "pop"))
     repl = NamedSharding(mesh, P())
-    batch_spec = NamedSharding(mesh, P(None, "data"))
+    batch_spec = NamedSharding(mesh, P(None, None, "data"))
 
-    params = jax.device_put(params, pop_spec)
+    params = jax.device_put(params, fold_pop_spec)
     masks_stacked = [
         {k: jax.device_put(v, pop_spec) for k, v in stage.items()}
         for stage in masks_stacked
     ]
-    fold_keys = jax.device_put(fold_keys, pop_spec)
+    fold_keys = jax.device_put(fold_keys, fold_pop_spec)
     out = dict(arrays)
-    for name in ("x_tr", "y_tr", "x_val", "y_val", "val_weight"):
+    for name in ("x_full", "y_full", "val_idx", "val_weight"):
         out[name] = jax.device_put(out[name], repl)
     out["batch_idx"] = jax.device_put(out["batch_idx"], batch_spec)
     return params, masks_stacked, fold_keys, out

@@ -3,5 +3,13 @@ resume, offline-safe dataset loaders, tracing/metrics."""
 
 from .checkpoint import Checkpointer, load_checkpoint
 from .profiling import EvalTimer, trace
+from .xla_cache import default_cache_dir, enable_compilation_cache
 
-__all__ = ["Checkpointer", "load_checkpoint", "EvalTimer", "trace"]
+__all__ = [
+    "Checkpointer",
+    "load_checkpoint",
+    "EvalTimer",
+    "trace",
+    "enable_compilation_cache",
+    "default_cache_dir",
+]

@@ -1,0 +1,62 @@
+"""Persistent XLA compilation cache (SURVEY.md §7 "hard parts" #1).
+
+The masked-supergraph design already means one in-process compile serves the
+whole search space (``models/cnn.py``), but a *restarted* search — the whole
+point of the checkpoint/resume subsystem (``utils/checkpoint.py``) — would
+pay the full XLA compile again.  jax ships a persistent on-disk compilation
+cache; this module is the one place that turns it on, so every entry point
+(models, bench, examples) shares the same knob.
+
+Two ways to enable it:
+
+- programmatically: ``enable_compilation_cache("/path/to/cache")`` (or pass
+  ``cache_dir=...`` to ``GeneticCnnModel`` / ``additional_parameters``);
+- environment: ``GENTUN_TPU_CACHE_DIR=/path/to/cache`` — picked up by
+  :func:`default_cache_dir` and applied automatically by the CNN model.
+
+The thresholds are dropped to zero because GA fitness programs are small by
+XLA standards: the default "only cache compiles > 1 s / > 0 bytes" heuristics
+would skip exactly the programs we want cached.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+__all__ = ["enable_compilation_cache", "default_cache_dir"]
+
+logger = logging.getLogger("gentun_tpu")
+
+_enabled_dir: Optional[str] = None
+
+
+def default_cache_dir() -> Optional[str]:
+    """Cache dir from the ``GENTUN_TPU_CACHE_DIR`` env var (None = disabled)."""
+    d = os.environ.get("GENTUN_TPU_CACHE_DIR", "").strip()
+    return d or None
+
+
+def enable_compilation_cache(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; safe to call before or after jax backend init (the cache is
+    consulted at compile time, not at backend-init time).  Returns the
+    directory so call sites can log it.
+    """
+    global _enabled_dir
+    cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
+    if _enabled_dir == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # GA fitness programs compile in well under the default 1 s threshold on
+    # CPU test runs; cache everything.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled_dir = cache_dir
+    logger.info("persistent XLA compilation cache enabled at %s", cache_dir)
+    return cache_dir

@@ -1,0 +1,83 @@
+"""Persistent XLA compilation cache tests (SURVEY.md §7 hard part #1).
+
+The claim under test: a *second process* running the same search config reuses
+the on-disk compiled program instead of recompiling.  Each run happens in a
+fresh subprocess (so no in-process jit cache can help), pinned to a single
+CPU device for byte-identical cache keys.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from gentun_tpu.utils.xla_cache import default_cache_dir, enable_compilation_cache
+
+RUN_CV = textwrap.dedent(
+    """
+    import json, os, sys, time
+    import numpy as np
+
+    cache_dir = sys.argv[1]
+
+    from gentun_tpu.models.cnn import GeneticCnnModel
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+    y = rng.integers(0, 2, size=64).astype(np.int32)
+    t0 = time.monotonic()
+    accs = GeneticCnnModel.cross_validate_population(
+        x, y, [{"S_1": (1, 0, 1)}],
+        nodes=(3,), kernels_per_layer=(4,), kfold=2, epochs=(1,),
+        learning_rate=(0.05,), batch_size=16, dense_units=8,
+        compute_dtype="float32", seed=0, cache_dir=cache_dir,
+    )
+    print(json.dumps({"wall_s": time.monotonic() - t0, "acc": float(accs[0])}))
+    """
+)
+
+
+def _run_in_subprocess(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # ONE device: the test asserts cache hits, and the cache key includes the
+    # device topology, so both runs must see identical topology.
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-c", RUN_CV, cache_dir],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+class TestPersistentCompilationCache:
+    def test_second_process_reuses_compiled_program(self, tmp_path):
+        cache_dir = str(tmp_path / "xla-cache")
+        self_snapshot = lambda: sorted(os.listdir(cache_dir))
+
+        _run_in_subprocess(cache_dir)
+        entries_after_first = self_snapshot()
+        assert entries_after_first, "first run wrote no cache entries"
+
+        _run_in_subprocess(cache_dir)
+        entries_after_second = self_snapshot()
+        # All compiles hit the persistent cache: no new entries were written.
+        assert entries_after_second == entries_after_first
+
+    def test_enable_is_idempotent(self, tmp_path):
+        d = str(tmp_path / "c")
+        assert enable_compilation_cache(d) == enable_compilation_cache(d)
+
+    def test_default_cache_dir_env(self, monkeypatch):
+        monkeypatch.delenv("GENTUN_TPU_CACHE_DIR", raising=False)
+        assert default_cache_dir() is None
+        monkeypatch.setenv("GENTUN_TPU_CACHE_DIR", "/tmp/foo")
+        assert default_cache_dir() == "/tmp/foo"
