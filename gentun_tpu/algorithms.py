@@ -32,6 +32,13 @@ __all__ = ["GeneticAlgorithm", "RussianRouletteGA"]
 logger = logging.getLogger("gentun_tpu")
 
 
+def _tuplify(obj: Any) -> Any:
+    """Inverse of JSON's tuple→list coercion for fitness-cache keys."""
+    if isinstance(obj, list):
+        return tuple(_tuplify(v) for v in obj)
+    return obj
+
+
 def _initialized_chip_count() -> int:
     """Local accelerator count, WITHOUT triggering jax backend init.
 
@@ -99,10 +106,10 @@ class GeneticAlgorithm:
     def evolve_population(self) -> None:
         """One generation step: evaluate → select → reproduce (SURVEY.md §3.1)."""
         t0 = time.monotonic()
-        # Count only the individuals actually trained this step (cached elites
-        # and distributed pre-assigned fitnesses don't inflate the metric).
-        evaluated = sum(1 for ind in self.population if not ind.fitness_evaluated)
-        self.population.evaluate()
+        # Count only the individuals actually trained this step (cached elites,
+        # fitness-cache hits, and dedup'd duplicates don't inflate the metric):
+        # evaluate() reports exactly how many hit the compute path.
+        evaluated = self.population.evaluate() or 0
         fittest = self.population.get_fittest()
         elapsed = max(time.monotonic() - t0, 1e-9)
         self._log_generation(fittest, evaluated, elapsed)
@@ -160,8 +167,22 @@ class GeneticAlgorithm:
     # -- (de)serialization state for checkpoint/resume ---------------------
 
     def state_dict(self) -> Dict[str, Any]:
+        # Fitness-cache keys are nested tuples, usually of JSON-native leaves
+        # (Individual.cache_key); JSON turns tuples into lists and _tuplify()
+        # reverses that exactly on load.  Keys that embed non-JSON values
+        # (bytes from ndarray params, arbitrary objects) are skipped — the
+        # checkpoint must never crash the search over a cache entry, and a
+        # dropped entry only costs a retrain after resume.
+        fitness_cache = []
+        for k, v in self.population.fitness_cache.items():
+            try:
+                json.dumps(k)
+            except (TypeError, ValueError):
+                continue
+            fitness_cache.append([k, v])
         return {
             "algorithm": type(self).__name__,
+            "fitness_cache": fitness_cache,
             "generation": self.generation,
             "tournament_size": self.tournament_size,
             "elitism": self.elitism,
@@ -203,6 +224,9 @@ class GeneticAlgorithm:
                 ind.set_fitness(ind_state["fitness"])
             individuals.append(ind)
         self.population.individuals = individuals
+        self.population.fitness_cache = {
+            _tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
+        }
 
 
 class RussianRouletteGA(GeneticAlgorithm):

@@ -67,6 +67,7 @@ class DistributedPopulation(Population):
         max_attempts: int = 3,
         heartbeat_timeout: float = 15.0,
         broker: Optional[JobBroker] = None,
+        fitness_cache: Optional[Dict[Any, float]] = None,
     ):
         super().__init__(
             species,
@@ -80,6 +81,7 @@ class DistributedPopulation(Population):
             additional_parameters=additional_parameters,
             seed=seed,
             rng=rng,
+            fitness_cache=fitness_cache,
         )
         self.job_timeout = job_timeout
         if broker is not None:
@@ -113,35 +115,59 @@ class DistributedPopulation(Population):
 
     # -- the distributed fitness sweep ------------------------------------
 
-    def evaluate(self) -> None:
+    def evaluate(self) -> int:
         """Publish one job per unevaluated individual; block for all replies.
+        Returns the number of jobs actually shipped (= trained remotely).
 
         This is the reference's population-level fitness override
         (SURVEY.md §3.2): genes out, fitness scalars back, barrier at the
-        end of the sweep.
+        end of the sweep.  Before anything hits the wire, the fitness cache
+        answers already-trained architectures, and duplicates within the
+        sweep collapse to one job (``Individual.cache_key`` — SURVEY.md §7
+        hard part #1); only genuinely new work reaches the workers.
         """
         pending = [ind for ind in self.individuals if not ind.fitness_evaluated]
+        pending = self._fill_from_cache(pending)
         if not pending:
-            return
+            return 0
         payloads: Dict[str, Dict[str, Any]] = {}
         by_id: Dict[str, Individual] = {}
+        dup_map: Dict[str, List[Individual]] = {}
+        rep_job: Dict[Any, str] = {}
         for ind in pending:
+            key = self._safe_cache_key(ind)
+            if key is not None and key in rep_job:
+                dup_map.setdefault(rep_job[key], []).append(ind)
+                continue
             job_id = JobBroker.new_job_id()
+            if key is not None:
+                rep_job[key] = job_id
             payloads[job_id] = {
                 "genes": ind.get_genes(),
                 "additional_parameters": dict(ind.additional_parameters),
             }
             by_id[job_id] = ind
-        logger.info("distributing %d fitness evaluations", len(payloads))
+        logger.info(
+            "distributing %d fitness evaluations (%d deduplicated)",
+            len(payloads),
+            len(pending) - len(payloads),
+        )
         results = self.broker.evaluate(payloads, timeout=self.job_timeout)
         for job_id, fitness in results.items():
-            by_id[job_id].set_fitness(fitness)
+            ind = by_id[job_id]
+            ind.set_fitness(fitness)
+            key = self._safe_cache_key(ind)
+            if key is not None:
+                self.fitness_cache[key] = float(fitness)
+            for dup in dup_map.get(job_id, []):
+                dup.set_fitness(fitness)
+        return len(payloads)
 
     # -- generational continuity ------------------------------------------
 
     def clone_with(self, individuals: Sequence[Individual]) -> "DistributedPopulation":
         """Next-generation population sharing this one's running broker."""
-        return DistributedPopulation(
+        clone = DistributedPopulation(
             species=self.species,
             individual_list=list(individuals),
             crossover_rate=self.crossover_rate,
@@ -151,7 +177,16 @@ class DistributedPopulation(Population):
             rng=self.rng,
             job_timeout=self.job_timeout,
             broker=self.broker,
+            fitness_cache=self.fitness_cache,
         )
+        # An embedded broker stays closeable through evolution: every clone
+        # of an owning population co-owns it, so close() on whichever
+        # population the caller ends up holding (the GA hands back clones)
+        # stops the listener.  JobBroker.stop() is idempotent, so original +
+        # clones closing in any order is safe.  Externally-provided brokers
+        # (broker= at construction) are never owned and never stopped here.
+        clone._owns_broker = self._owns_broker
+        return clone
 
 
 class DistributedGridPopulation(DistributedPopulation):

@@ -29,6 +29,26 @@ from .genes import GenomeSpec, boosting_genome, genetic_cnn_genome
 __all__ = ["Individual", "GeneticCnnIndividual", "BoostingIndividual", "XgboostIndividual"]
 
 
+def _freeze(obj: Any) -> Any:
+    """Recursively convert ``obj`` into a hashable, order-stable structure.
+
+    Dicts become sorted ``(key, value)`` tuples, sequences become tuples,
+    numpy scalars/arrays become plain values/bytes.  Used to build fitness
+    cache keys out of genome dicts and ``additional_parameters``.
+    """
+    if isinstance(obj, Mapping):
+        return tuple((k, _freeze(v)) for k, v in sorted(obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    if isinstance(obj, (set, frozenset)):
+        return tuple(sorted((_freeze(v) for v in obj), key=repr))
+    if isinstance(obj, np.ndarray):
+        return (obj.shape, obj.dtype.str, obj.tobytes())
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
 class Individual:
     """A candidate solution: genome dict + lazily evaluated fitness.
 
@@ -100,6 +120,21 @@ class Individual:
     def fitness_evaluated(self) -> bool:
         return self._fitness is not None
 
+    def cache_key(self):
+        """Hashable identity of this individual's *training job*.
+
+        Two individuals with equal keys are guaranteed the same expected
+        fitness, so population/GA-level caches (``Population.fitness_cache``)
+        train one representative and share the result across duplicates,
+        re-derived elites, and later generations — the reference re-trains
+        every new Individual object even when its genome already ran
+        (SURVEY.md §7 "hard parts" #1).  Default: the frozen
+        ``(genes, additional_parameters)`` pair; species can collapse more
+        (:meth:`GeneticCnnIndividual.cache_key` maps architecture-isomorphic
+        genomes to one key via :func:`gentun_tpu.ops.dag.canonical_key`).
+        """
+        return (type(self).__name__, _freeze(self.genes), _freeze(self.additional_parameters))
+
     # -- genetic operators -------------------------------------------------
 
     def crossover(self, partner: "Individual", rng: Optional[np.random.Generator] = None) -> "Individual":
@@ -170,6 +205,19 @@ class GeneticCnnIndividual(Individual):
 
     def build_spec(self, **params) -> GenomeSpec:
         return genetic_cnn_genome(tuple(params.get("nodes", (3, 5))))
+
+    def cache_key(self):
+        """Collapse architecture-isomorphic genomes to one cache entry.
+
+        Distinct bit-strings that decode to the same network up to node
+        relabeling (:func:`gentun_tpu.ops.dag.canonical_key`) share a key —
+        beyond exact-duplicate dedup, this means e.g. the k=3 single-edge
+        graphs 1→2 and 2→3 train once between them.
+        """
+        from .ops.dag import canonical_key
+
+        nodes = tuple(self.additional_parameters.get("nodes", (3, 5)))
+        return (type(self).__name__, canonical_key(self.genes, nodes), _freeze(self.additional_parameters))
 
     def evaluate(self) -> float:
         if self.x_train is None or self.y_train is None:

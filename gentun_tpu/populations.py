@@ -18,6 +18,7 @@ individuals/hour/chip lever).  The per-individual lazy path
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Type
 
 import numpy as np
@@ -25,6 +26,11 @@ import numpy as np
 from .individuals import Individual
 
 __all__ = ["Population", "GridPopulation"]
+
+logger = logging.getLogger("gentun_tpu")
+
+#: species whose cache_key() already raised once (log each species once)
+_cache_key_warned: set = set()
 
 
 class Population:
@@ -50,6 +56,7 @@ class Population:
         additional_parameters: Optional[Dict[str, Any]] = None,
         seed: Optional[int] = None,
         rng: Optional[np.random.Generator] = None,
+        fitness_cache: Optional[Dict[Any, float]] = None,
     ):
         self.species = species
         self.x_train = x_train
@@ -59,6 +66,10 @@ class Population:
         self.maximize = maximize
         self.additional_parameters = dict(additional_parameters or {})
         self.rng = rng if rng is not None else np.random.default_rng(seed)
+        # Fitness by Individual.cache_key(): shared across generations via
+        # clone_with, so an architecture (not just an Individual object) is
+        # trained at most once per search (SURVEY.md §7 hard part #1).
+        self.fitness_cache: Dict[Any, float] = fitness_cache if fitness_cache is not None else {}
 
         if individual_list is not None:
             self.individuals: List[Individual] = list(individual_list)
@@ -118,24 +129,118 @@ class Population:
 
     # -- fitness -----------------------------------------------------------
 
-    def evaluate(self) -> None:
-        """Ensure every individual has a fitness.
+    def evaluate(self) -> int:
+        """Ensure every individual has a fitness; returns the number that
+        actually *trained* (cache hits and dedup'd duplicates don't count —
+        the GA uses this for the individuals/hour/chip metric).
 
-        Batched TPU path: if the species' fitness model exposes
-        ``cross_validate_population`` (see ``models/cnn.py``), all unevaluated
-        individuals with identical ``additional_parameters`` are trained in
-        one vmapped program.  Falls back to the reference's sequential lazy
-        loop otherwise (SURVEY.md §3.1).
+        Order of attack, each step narrowing the pending set:
+
+        1. **cache** — individuals whose :meth:`Individual.cache_key` was
+           already trained (this generation or an earlier one, via the
+           cache ``clone_with`` carries forward) get the stored fitness;
+        2. **dedup** — of the rest, one representative per distinct key
+           trains; duplicates inherit its result;
+        3. **group-wise batched training** — representatives are grouped by
+           ``additional_parameters`` and each group trains as ONE vmapped
+           program when the species' model exposes
+           ``cross_validate_population`` (``models/cnn.py``) — divergent
+           configs no longer force the whole population sequential;
+        4. **sequential fallback** — anything else takes the reference's
+           lazy per-individual path (SURVEY.md §3.1).
         """
         pending = [ind for ind in self.individuals if not ind.fitness_evaluated]
-        if not pending:
-            return
-        if not self._evaluate_batched(pending):
-            for ind in pending:
-                ind.get_fitness()
+        pending = self._fill_from_cache(pending)
+        trained = 0
+        for group in self._group_by_params(pending):
+            reps = self._dedupe_group(group)
+            if not self._evaluate_batched(reps):
+                for ind in reps:
+                    ind.get_fitness()
+            trained += len(reps)
+            self._publish_group(group, reps)
+        return trained
+
+    # -- cache / dedup plumbing -------------------------------------------
+
+    @staticmethod
+    def _safe_cache_key(ind: Individual):
+        """``ind.cache_key()``, or None (= never cached) if it can't be built.
+
+        A failure downgrades the search to cache-less behavior (correct but
+        retrains every genome), so the first one per species is logged loudly
+        rather than swallowed.
+        """
+        try:
+            return ind.cache_key()
+        except Exception:
+            species = type(ind).__name__
+            if species not in _cache_key_warned:
+                _cache_key_warned.add(species)
+                logger.warning(
+                    "cache_key() failed for species %s — fitness caching and "
+                    "dedup are DISABLED for it (every genome will retrain)",
+                    species,
+                    exc_info=True,
+                )
+            return None
+
+    def _fill_from_cache(self, pending: List[Individual]) -> List[Individual]:
+        """Assign cached fitnesses; return the individuals still unevaluated."""
+        remaining: List[Individual] = []
+        for ind in pending:
+            key = self._safe_cache_key(ind)
+            if key is not None and key in self.fitness_cache:
+                ind.set_fitness(self.fitness_cache[key])
+            else:
+                remaining.append(ind)
+        return remaining
+
+    @staticmethod
+    def _group_by_params(pending: List[Individual]) -> List[List[Individual]]:
+        """Partition by ``additional_parameters`` (batched training needs one
+        shared config per compiled program — same grouping the distributed
+        worker applies, ``distributed/client.py``).  Keys via ``_freeze``:
+        collision-free even for numpy-array params, unlike ``repr``."""
+        from .individuals import _freeze
+
+        groups: Dict[Any, List[Individual]] = {}
+        for ind in pending:
+            key = _freeze(ind.additional_parameters)
+            groups.setdefault(key, []).append(ind)
+        return list(groups.values())
+
+    def _dedupe_group(self, group: List[Individual]) -> List[Individual]:
+        """First individual per distinct cache key; un-keyable ones all pass."""
+        reps: List[Individual] = []
+        seen = set()
+        for ind in group:
+            key = self._safe_cache_key(ind)
+            if key is None or key not in seen:
+                if key is not None:
+                    seen.add(key)
+                reps.append(ind)
+        return reps
+
+    def _publish_group(self, group: List[Individual], reps: List[Individual]) -> None:
+        """Store representatives' results in the cache; fan out to duplicates."""
+        for ind in reps:
+            key = self._safe_cache_key(ind)
+            if key is not None:
+                self.fitness_cache[key] = ind.get_fitness()
+        for ind in group:
+            if not ind.fitness_evaluated:
+                ind.set_fitness(self.fitness_cache[self._safe_cache_key(ind)])
 
     def _evaluate_batched(self, pending: List[Individual]) -> bool:
-        """Try the single-program population evaluation; True on success."""
+        """Try the single-program batched evaluation; True on success.
+
+        ``pending`` shares one ``additional_parameters`` dict by construction
+        (:meth:`_group_by_params`), so the whole group decodes under one
+        config and trains as one vmapped XLA program.
+        """
+        if not pending:
+            return True
         if self.x_train is None or self.y_train is None:
             return False
         model_cls = getattr(self.species, "model_cls", None)
@@ -152,14 +257,9 @@ class Population:
         batch_fn = getattr(model_cls, "cross_validate_population", None)
         if batch_fn is None:
             return False
-        # Batched evaluation requires one shared config across the population.
-        # Individuals added via add_individual() can carry divergent
-        # additional_parameters (e.g. different stage sizes); those must take
-        # the sequential path or they'd be decoded under the wrong config.
-        if any(ind.additional_parameters != self.additional_parameters for ind in pending):
-            return False
+        params = pending[0].additional_parameters
         genomes = [ind.get_genes() for ind in pending]
-        fitnesses = batch_fn(self.x_train, self.y_train, genomes, **self.additional_parameters)
+        fitnesses = batch_fn(self.x_train, self.y_train, genomes, **params)
         for ind, fit in zip(pending, fitnesses):
             ind.set_fitness(float(fit))
         return True
@@ -185,6 +285,7 @@ class Population:
             maximize=self.maximize,
             additional_parameters=self.additional_parameters,
             rng=self.rng,
+            fitness_cache=self.fitness_cache,
         )
 
     def get_fittest(self) -> Individual:

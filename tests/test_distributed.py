@@ -278,3 +278,87 @@ def test_clone_with_preserves_type_for_plain_population():
     clone = pop.clone_with(list(pop.individuals))
     assert type(clone) is Population
     assert clone.rng is pop.rng
+
+
+class CountingOneMax(OneMax):
+    """Worker-side eval counter (worker threads share this process's memory)."""
+
+    evals = 0
+
+    def evaluate(self):
+        CountingOneMax.evals += 1
+        return super().evaluate()
+
+
+class TestMasterSideDedup:
+    def test_duplicate_genomes_ship_one_job(self):
+        CountingOneMax.evals = 0
+        dup = {"S_1": (1, 0, 1, 0, 1, 0), "S_2": (1, 1, 0, 0, 1, 0)}
+        other = {"S_1": (0,) * 6, "S_2": (1,) * 6}
+        inds = [
+            CountingOneMax(genes=g, additional_parameters={"nodes": (4, 4)})
+            for g in (dup, dup, dup, other)
+        ]
+        with DistributedPopulation(
+            CountingOneMax,
+            individual_list=inds,
+            additional_parameters={"nodes": (4, 4)},
+            port=0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(CountingOneMax, port)
+            try:
+                pop.evaluate()
+            finally:
+                stop.set()
+        assert CountingOneMax.evals == 2  # 2 unique genomes, not 4 jobs
+        assert all(ind.fitness_evaluated for ind in pop)
+        assert pop[0].get_fitness() == pop[1].get_fitness() == pop[2].get_fitness()
+
+    def test_cache_answers_next_generation_without_jobs(self):
+        CountingOneMax.evals = 0
+        g = {"S_1": (1, 1, 1, 0, 0, 0), "S_2": (0, 0, 0, 1, 1, 1)}
+        inds = [CountingOneMax(genes=g, additional_parameters={"nodes": (4, 4)})]
+        with DistributedPopulation(
+            CountingOneMax,
+            individual_list=inds,
+            additional_parameters={"nodes": (4, 4)},
+            port=0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(CountingOneMax, port)
+            try:
+                pop.evaluate()
+                assert CountingOneMax.evals == 1
+                # next generation re-derives the same genome: cache, no wire
+                stop.set()  # no workers alive — a shipped job would hang
+                child = pop.spawn(genes=g)
+                nxt = pop.clone_with([child])
+                nxt.job_timeout = 5.0
+                nxt.evaluate()
+                assert child.get_fitness() == pop[0].get_fitness()
+                assert CountingOneMax.evals == 1
+            finally:
+                stop.set()
+
+
+class TestBrokerOwnership:
+    def test_close_on_clone_stops_embedded_broker(self):
+        pop = DistributedPopulation(OneMax, size=2, seed=0, port=0)
+        clone = pop.clone_with([pop[0].copy()])
+        assert clone._owns_broker  # co-owns: GA holds only clones after gen 1
+        clone.close()
+        assert not pop.broker._started.is_set()
+        pop.close()  # idempotent: original closing after the clone is safe
+
+    def test_external_broker_never_stopped_by_clones(self):
+        broker = JobBroker(port=0).start()
+        try:
+            pop = DistributedPopulation(OneMax, size=2, seed=0, broker=broker)
+            clone = pop.clone_with([pop[0].copy()])
+            assert not clone._owns_broker
+            clone.close()
+            pop.close()
+            assert broker._started.is_set()  # still running
+        finally:
+            broker.stop()

@@ -1,0 +1,192 @@
+"""Fitness cache + dedup + group-wise batched evaluation (SURVEY.md §7 #1).
+
+Covers the population-level levers the reference lacks: architecturally
+identical genomes train once per search (canonical-key dedup), cached
+fitnesses survive generations and checkpoint/resume, and divergent
+``additional_parameters`` split into batched groups instead of forcing the
+whole population onto the sequential path.
+"""
+
+import numpy as np
+
+from gentun_tpu.algorithms import GeneticAlgorithm
+from gentun_tpu.genes import genetic_cnn_genome
+from gentun_tpu.individuals import GeneticCnnIndividual, Individual
+from gentun_tpu.populations import Population
+
+
+class CountingEval(Individual):
+    """Sequential-path species: counts evaluate() calls."""
+
+    calls = 0
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (3,))))
+
+    def evaluate(self):
+        type(self).calls += 1
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+class CountingBatchModel:
+    """Batched-path fitness backend: records every cross_validate_population call."""
+
+    calls = []  # list of (n_genomes, params_key)
+
+    @classmethod
+    def cross_validate_population(cls, x, y, genomes, **params):
+        cls.calls.append((len(genomes), repr(sorted(params.items()))))
+        return np.array([float(sum(sum(g) for g in gen.values())) for gen in genomes])
+
+
+class BatchedCnnIndividual(GeneticCnnIndividual):
+    model_cls = CountingBatchModel
+
+
+def _pop(species, genomes, **params):
+    data = np.zeros(1)
+    inds = [
+        species(x_train=data, y_train=data, genes=g, additional_parameters=dict(params))
+        for g in genomes
+    ]
+    return Population(
+        species,
+        x_train=data,
+        y_train=data,
+        individual_list=inds,
+        additional_parameters=dict(params),
+    )
+
+
+class TestDedupWithinGeneration:
+    def test_exact_duplicates_train_once_sequential(self):
+        CountingEval.calls = 0
+        g = {"S_1": (1, 0, 1)}
+        pop = _pop(CountingEval, [g, g, g, {"S_1": (1, 1, 1)}], nodes=(3,))
+        pop.evaluate()
+        assert CountingEval.calls == 2  # two distinct genomes
+        assert all(ind.fitness_evaluated for ind in pop)
+        assert pop[0].get_fitness() == pop[1].get_fitness() == pop[2].get_fitness()
+
+    def test_isomorphic_architectures_share_one_training(self):
+        # k=3 single-edge DAGs 1→2 and 2→3 are the same architecture up to
+        # node relabeling: canonical_key collapses them (ops/dag.py).
+        CountingBatchModel.calls = []
+        edge_12 = {"S_1": (1, 0, 0)}
+        edge_23 = {"S_1": (0, 0, 1)}
+        chain = {"S_1": (1, 0, 1)}
+        pop = _pop(BatchedCnnIndividual, [edge_12, edge_23, chain], nodes=(3,))
+        pop.evaluate()
+        assert len(CountingBatchModel.calls) == 1
+        assert CountingBatchModel.calls[0][0] == 2  # one rep per canonical key
+        assert all(ind.fitness_evaluated for ind in pop)
+
+    def test_n_genomes_k_keys_trains_exactly_k(self):
+        CountingBatchModel.calls = []
+        genomes = [
+            {"S_1": (1, 0, 0)},  # iso class A (single edge)
+            {"S_1": (0, 0, 1)},  # iso class A
+            {"S_1": (1, 0, 1)},  # chain 1→2→3
+            {"S_1": (1, 0, 1)},  # chain again (exact dup)
+            {"S_1": (1, 1, 1)},  # triangle
+            {"S_1": (0, 0, 0)},  # empty
+        ]
+        pop = _pop(BatchedCnnIndividual, genomes, nodes=(3,))
+        pop.evaluate()
+        trained = sum(n for n, _ in CountingBatchModel.calls)
+        assert trained == 4  # distinct keys: single-edge class, chain, triangle, empty
+        assert all(ind.fitness_evaluated for ind in pop)
+
+
+class TestCrossGenerationCache:
+    def test_ga_never_retrains_a_seen_architecture(self):
+        CountingEval.calls = 0
+        pop = Population(
+            CountingEval,
+            x_train=np.zeros(1),
+            y_train=np.zeros(1),
+            size=10,
+            seed=0,
+            additional_parameters={"nodes": (3,)},
+            mutation_rate=0.1,
+        )
+        ga = GeneticAlgorithm(pop, seed=0)
+        ga.run(8)
+        # nodes=(3,) has only 8 raw genomes; a cache-less GA would retrain
+        # children every generation (~10 evals/gen).  With the cache, total
+        # trainings are bounded by the number of distinct genomes.
+        assert CountingEval.calls <= 8
+
+    def test_cache_travels_through_clone_with(self):
+        CountingEval.calls = 0
+        g = {"S_1": (1, 0, 1)}
+        pop = _pop(CountingEval, [g], nodes=(3,))
+        pop.evaluate()
+        assert CountingEval.calls == 1
+        child = pop.spawn(genes=g)  # fresh, unevaluated individual
+        nxt = pop.clone_with([child])
+        nxt.evaluate()
+        assert CountingEval.calls == 1  # cache hit, no retrain
+        assert child.get_fitness() == pop[0].get_fitness()
+
+    def test_cache_survives_checkpoint_roundtrip(self):
+        CountingEval.calls = 0
+        import json
+
+        pop = _pop(CountingEval, [{"S_1": (1, 0, 1)}, {"S_1": (1, 1, 1)}], nodes=(3,))
+        ga = GeneticAlgorithm(pop, seed=1)
+        pop.evaluate()
+        state = json.loads(json.dumps(ga.state_dict()))  # through-JSON, like the checkpointer
+
+        pop2 = _pop(CountingEval, [{"S_1": (1, 0, 1)}], nodes=(3,))
+        ga2 = GeneticAlgorithm(pop2, seed=1)
+        ga2.load_state_dict(state)
+        assert ga2.population.fitness_cache == pop.fitness_cache
+        # a fresh individual with a cached genome must not retrain
+        calls_before = CountingEval.calls
+        probe = ga2.population.spawn(genes={"S_1": (1, 1, 1)})
+        ga2.population.individuals.append(probe)
+        ga2.population.evaluate()
+        assert CountingEval.calls == calls_before
+
+
+class TestGroupwiseBatching:
+    def test_mixed_params_split_into_batched_groups(self):
+        CountingBatchModel.calls = []
+        data = np.zeros(1)
+        fast = {"nodes": (3,), "epochs": (1,)}
+        slow = {"nodes": (3,), "epochs": (2,)}
+        inds = [
+            BatchedCnnIndividual(x_train=data, y_train=data, genes={"S_1": (1, 0, 1)}, additional_parameters=fast),
+            BatchedCnnIndividual(x_train=data, y_train=data, genes={"S_1": (1, 1, 1)}, additional_parameters=fast),
+            BatchedCnnIndividual(x_train=data, y_train=data, genes={"S_1": (1, 0, 1)}, additional_parameters=slow),
+            BatchedCnnIndividual(x_train=data, y_train=data, genes={"S_1": (1, 1, 1)}, additional_parameters=slow),
+        ]
+        pop = Population(
+            BatchedCnnIndividual,
+            x_train=data,
+            y_train=data,
+            individual_list=inds,
+            additional_parameters=fast,
+        )
+        pop.evaluate()
+        # Two groups, each trained in ONE batched call — not 4 sequential.
+        assert len(CountingBatchModel.calls) == 2
+        assert sorted(n for n, _ in CountingBatchModel.calls) == [2, 2]
+        assert all(ind.fitness_evaluated for ind in pop)
+
+    def test_same_genome_different_params_not_conflated(self):
+        CountingBatchModel.calls = []
+        data = np.zeros(1)
+        a = {"nodes": (3,), "epochs": (1,)}
+        b = {"nodes": (3,), "epochs": (2,)}
+        inds = [
+            BatchedCnnIndividual(x_train=data, y_train=data, genes={"S_1": (1, 0, 1)}, additional_parameters=a),
+            BatchedCnnIndividual(x_train=data, y_train=data, genes={"S_1": (1, 0, 1)}, additional_parameters=b),
+        ]
+        pop = Population(
+            BatchedCnnIndividual, x_train=data, y_train=data, individual_list=inds, additional_parameters=a
+        )
+        pop.evaluate()
+        # The cache key includes additional_parameters: both train.
+        assert sum(n for n, _ in CountingBatchModel.calls) == 2
