@@ -74,7 +74,7 @@ except ImportError:  # pragma: no cover
 try:  # pragma: no cover
     from .distributed.server import DistributedPopulation, DistributedGridPopulation  # noqa: F401
     from .distributed.client import GentunClient  # noqa: F401
-    from .distributed.broker import JobBroker, JobFailed  # noqa: F401
+    from .distributed.broker import GatherTimeout, JobBroker, JobFailed  # noqa: F401
 
     __all__ += [
         "DistributedPopulation",
@@ -82,6 +82,7 @@ try:  # pragma: no cover
         "GentunClient",
         "JobBroker",
         "JobFailed",
+        "GatherTimeout",
     ]
 except ImportError:  # pragma: no cover
     pass

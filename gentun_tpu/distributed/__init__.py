@@ -8,13 +8,14 @@ Only genes, hyperparameters, and fitness scalars cross the wire; data and
 device collectives stay inside each worker (ICI, via jax).
 """
 
-from .broker import JobBroker, JobFailed
+from .broker import GatherTimeout, JobBroker, JobFailed
 from .client import GentunClient
 from .server import DistributedGridPopulation, DistributedPopulation
 
 __all__ = [
     "JobBroker",
     "JobFailed",
+    "GatherTimeout",
     "GentunClient",
     "DistributedPopulation",
     "DistributedGridPopulation",

@@ -32,6 +32,7 @@ model wastes the MXU on small populations.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import itertools
 import logging
 import threading
@@ -40,15 +41,49 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
-from .protocol import ProtocolError, decode, encode
+from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
 
-__all__ = ["JobBroker", "JobFailed"]
+__all__ = ["JobBroker", "JobFailed", "GatherTimeout"]
 
 logger = logging.getLogger("gentun_tpu.distributed")
 
 
 class JobFailed(RuntimeError):
-    """A job exhausted its delivery attempts (every try raised worker-side)."""
+    """Some jobs exhausted their delivery attempts (every try raised worker-side).
+
+    Raised by :meth:`JobBroker.gather` only after EVERY submitted job reached
+    a terminal state, so it carries the full picture of the barrier:
+
+    - :attr:`failures` — ``{job_id: reason}`` for the jobs that failed;
+    - :attr:`partial` — ``{job_id: fitness}`` for the jobs that succeeded.
+
+    The broker prunes all state for the gathered jobs before raising, so the
+    defined retry is simply: apply ``partial``, then submit fresh jobs for
+    the failed work (``DistributedPopulation.evaluate`` does exactly this —
+    calling it again after a ``JobFailed`` reships only the failed
+    individuals, with reset attempt counts).
+    """
+
+    def __init__(self, message: str, failures: Optional[Dict[str, str]] = None,
+                 partial: Optional[Dict[str, float]] = None):
+        super().__init__(message)
+        self.failures = dict(failures or {})
+        self.partial = dict(partial or {})
+
+
+class GatherTimeout(TimeoutError):
+    """The barrier timed out with jobs still unfinished (and none failed —
+    a deadline with permanent failures raises :class:`JobFailed` instead).
+
+    :attr:`partial` carries the fitnesses that DID arrive before the
+    deadline, so a straggler-timeout generation keeps its finished work.
+    The broker cancels the unfinished jobs and prunes all gathered state
+    before raising, so a resubmit starts clean.
+    """
+
+    def __init__(self, message: str, partial: Optional[Dict[str, float]] = None):
+        super().__init__(message)
+        self.partial = dict(partial or {})
 
 
 class _Worker:
@@ -188,6 +223,13 @@ class JobBroker:
         if not self._started.is_set():
             raise RuntimeError("broker not started")
 
+        # Validate frame size in the CALLER's thread so an oversized payload
+        # raises where the submitter can see it, instead of being swallowed
+        # by the loop thread's best-effort writer.  Genes are tiny by design
+        # (SURVEY.md §1) — anything near the cap is a bug worth surfacing.
+        for job_id, payload in payloads.items():
+            encode({"type": "jobs", "jobs": [{"job_id": job_id, **payload}]})
+
         def _enqueue():
             for job_id, payload in payloads.items():
                 self._payloads[job_id] = payload
@@ -204,27 +246,127 @@ class JobBroker:
         """
         deadline = None if timeout is None else time.monotonic() + timeout
         want = set(job_ids)
+        no_workers_since: Optional[float] = None
         with self._cond:
             while True:
-                failed = want & set(self._failures)
-                if failed:
-                    job_id = sorted(failed)[0]
-                    raise JobFailed(f"job {job_id}: {self._failures[job_id]}")
-                if all(j in self._results for j in want):
-                    out = {j: self._results[j] for j in want}
-                    # Prune satisfied jobs so master-side state stays O(one
-                    # generation), not O(whole search).  Late duplicates are
-                    # dropped by the _payloads membership check, so pruning
-                    # cannot resurrect a job.
-                    for j in want:
-                        self._results.pop(j, None)
-                        self._fail_counts.pop(j, None)
+                done_r = {j for j in want if j in self._results}
+                done_f = {j for j in want if j in self._failures}
+                open_jobs = want - done_r - done_f
+                # The barrier waits for every job to reach a TERMINAL state
+                # (result or permanent failure) before deciding the outcome:
+                # one poisoned individual must not discard the rest of the
+                # generation's finished work.
+                if not open_jobs:
+                    out = {j: self._results[j] for j in done_r}
+                    failed = {j: self._failures[j] for j in done_f}
+                    self._prune_gathered(want)
+                    if failed:
+                        job_id = sorted(failed)[0]
+                        raise JobFailed(
+                            f"{len(failed)} of {len(want)} job(s) failed permanently "
+                            f"(first: {job_id}: {failed[job_id]})",
+                            failures=failed,
+                            partial=out,
+                        )
                     return out
+                # Fail fast when waiting cannot help: a permanent failure is
+                # already recorded and NO worker is connected, so the open
+                # jobs sit in the queue with nobody to run them.  (A busy
+                # connected worker always eventually produces a result, a
+                # fail, or a disconnect — all of which wake this loop.)
+                # The no-workers condition must HOLD for a full heartbeat
+                # window before we act on it: a worker in its reconnect
+                # backoff makes self._workers transiently empty, and
+                # aborting then would cancel still-runnable jobs.
+                if done_f and not self._workers:
+                    now = time.monotonic()
+                    if no_workers_since is None:
+                        no_workers_since = now
+                    if now - no_workers_since >= self._heartbeat_timeout:
+                        out = {j: self._results[j] for j in done_r}
+                        failed = {j: self._failures[j] for j in done_f}
+                        self._prune_gathered(want)
+                        self._cancel_jobs(open_jobs)
+                        raise JobFailed(
+                            f"{len(done_f)} job(s) failed permanently with no workers "
+                            f"connected for {self._heartbeat_timeout:.0f}s; cancelled "
+                            f"{len(open_jobs)} undispatchable job(s)",
+                            failures=failed,
+                            partial=out,
+                        )
+                else:
+                    no_workers_since = None
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    missing = sorted(j for j in want if j not in self._results)
-                    raise TimeoutError(f"{len(missing)} job(s) unfinished: {missing[:5]}...")
-                self._cond.wait(timeout=remaining if remaining is not None else 1.0)
+                    out = {j: self._results[j] for j in done_r}
+                    failed = {j: self._failures[j] for j in done_f}
+                    # Cancel + prune so timed-out generations leave no state
+                    # behind (late results are then dropped as stale) and a
+                    # resubmit starts with fresh attempt counts.
+                    self._prune_gathered(want)
+                    self._cancel_jobs(open_jobs)
+                    missing = sorted(open_jobs)
+                    if failed:
+                        raise JobFailed(
+                            f"barrier timed out with {len(failed)} permanent failure(s) "
+                            f"and {len(missing)} unfinished job(s)",
+                            failures=failed,
+                            partial=out,
+                        )
+                    raise GatherTimeout(
+                        f"{len(missing)} job(s) unfinished: {missing[:5]}...",
+                        partial=out,
+                    )
+                # Poll at ≥1 Hz even under a long finite deadline: the
+                # no-workers fail-fast above re-evaluates on wake-ups only,
+                # and with zero workers connected nothing else notifies.
+                self._cond.wait(timeout=min(remaining, 1.0) if remaining is not None else 1.0)
+
+    def _prune_gathered(self, want: Set[str]) -> None:
+        """Drop all master-side state for a gathered job set (holds _cond).
+
+        Keeps the master O(one generation), not O(whole search), and gives a
+        post-failure resubmit fresh attempt counts.  Late duplicates are
+        dropped by the _payloads membership check, so pruning cannot
+        resurrect a job.
+        """
+        for j in want:
+            self._results.pop(j, None)
+            self._failures.pop(j, None)
+            self._fail_counts.pop(j, None)
+
+    def _cancel_jobs(self, job_ids: Set[str]) -> None:
+        """Withdraw still-open jobs (loop-thread async; safe from any thread).
+
+        Removing the payload is the single source of truth: dispatch skips
+        pending ids without payloads, and any result that still arrives is
+        dropped as stale."""
+        ids = set(job_ids)
+        if not ids or self._loop is None:
+            return
+
+        def _do():
+            for j in ids:
+                self._payloads.pop(j, None)
+            if any(j in ids for j in self._pending):
+                # Drain cancelled ids now: with no worker connected nothing
+                # else pops the deque, and a retry loop would grow it by one
+                # generation per attempt.
+                self._pending = deque(j for j in self._pending if j not in ids)
+            for w in self._workers.values():
+                w.in_flight -= ids
+            # Late sweep: a result that was mid-delivery when gather pruned
+            # (past the payload check, blocked on _cond) lands in _results
+            # BEFORE this callback runs — handler and callbacks share the
+            # loop thread, and call_soon callbacks queue behind the handler.
+            # Sweeping here therefore removes any such orphan for good.
+            with self._cond:
+                for j in ids:
+                    self._results.pop(j, None)
+                    self._failures.pop(j, None)
+                    self._fail_counts.pop(j, None)
+
+        self._loop.call_soon_threadsafe(_do)
 
     def evaluate(self, payloads: Dict[str, Dict[str, Any]], timeout: Optional[float] = None) -> Dict[str, float]:
         """submit + gather in one call."""
@@ -238,17 +380,38 @@ class JobBroker:
     # -- loop-thread internals --------------------------------------------
 
     def _dispatch(self) -> None:
-        """Hand pending jobs to workers with spare credit (competing consumers)."""
+        """Hand pending jobs to workers with spare credit (competing consumers).
+
+        Everything a worker's credit allows goes out as ONE ``jobs`` frame —
+        credit-based prefetch.  The worker never guesses (with a read
+        timeout) whether more of its batch is still in flight: a capacity-8
+        worker gets its 8 jobs in a single frame whatever the DCN latency.
+        """
         if not self._pending:
             return
         for w in list(self._workers.values()):
+            batch: List[Dict[str, Any]] = []
+            batch_bytes = 0
+            # Keep each frame well under the protocol cap: submit() bounds
+            # single jobs, but a large-capacity worker's combined batch could
+            # exceed it — flush into multiple `jobs` frames when needed (the
+            # client reads frames one per consume-loop iteration).
+            soft_cap = MAX_MESSAGE_BYTES // 2
             while w.credit > 0 and self._pending:
                 job_id = self._pending.popleft()
                 if job_id not in self._payloads:  # already satisfied/failed
                     continue
                 w.credit -= 1
                 w.in_flight.add(job_id)
-                self._send(w, {"type": "job", "job_id": job_id, **self._payloads[job_id]})
+                entry = {"job_id": job_id, **self._payloads[job_id]}
+                entry_bytes = len(encode(entry))
+                if batch and batch_bytes + entry_bytes > soft_cap:
+                    self._send(w, {"type": "jobs", "jobs": batch})
+                    batch, batch_bytes = [], 0
+                batch.append(entry)
+                batch_bytes += entry_bytes
+            if batch:
+                self._send(w, {"type": "jobs", "jobs": batch})
             if not self._pending:
                 break
 
@@ -284,7 +447,13 @@ class JobBroker:
             if hello.get("type") != "hello":
                 writer.write(encode({"type": "error", "reason": "expected hello"}))
                 return
-            if self._token is not None and hello.get("token") != self._token:
+            # Constant-time compare: the token is a shared secret and the
+            # broker may listen on a routable DCN address.  Compare as UTF-8
+            # bytes — compare_digest raises TypeError on non-ASCII str.
+            if self._token is not None and not hmac.compare_digest(
+                str(hello.get("token") or "").encode("utf-8"),
+                self._token.encode("utf-8"),
+            ):
                 writer.write(encode({"type": "error", "reason": "bad token"}))
                 logger.warning("worker rejected: bad token")
                 return

@@ -169,37 +169,20 @@ class GentunClient:
     def _consume(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
             self._send({"type": "ready", "credit": self.capacity})
-            jobs = [self._await_job()]
-            # Drain whatever the broker pushed alongside (capacity > 1): the
-            # batch then trains as one vmapped program.
-            jobs.extend(self._drain_jobs(self.capacity - 1))
-            self._evaluate_batch(jobs)
+            # The broker delivers everything our credit allows as ONE `jobs`
+            # frame (credit-based prefetch), so a capacity-N worker receives
+            # its whole batch in a single blocking read — no drain window, no
+            # read timeouts through the buffered reader, and the batch trains
+            # as one vmapped program whatever the network latency was.
+            self._evaluate_batch(self._await_jobs())
 
-    def _await_job(self) -> Dict[str, Any]:
+    def _await_jobs(self) -> List[Dict[str, Any]]:
         while True:
             msg = self._recv()
-            if msg["type"] == "job":
-                return msg
+            if msg["type"] == "jobs":
+                return list(msg["jobs"])
             if msg["type"] not in ("pong", "welcome"):
                 logger.warning("unexpected message %r", msg["type"])
-
-    def _drain_jobs(self, budget: int) -> List[Dict[str, Any]]:
-        """Non-blocking-ish read of co-delivered jobs (50 ms window)."""
-        jobs: List[Dict[str, Any]] = []
-        if budget <= 0:
-            return jobs
-        self._sock.settimeout(0.05)
-        try:
-            while len(jobs) < budget:
-                try:
-                    msg = self._recv()
-                except (socket.timeout, TimeoutError):
-                    break
-                if msg["type"] == "job":
-                    jobs.append(msg)
-        finally:
-            self._sock.settimeout(None)
-        return jobs
 
     # -- evaluation --------------------------------------------------------
 
@@ -210,9 +193,19 @@ class GentunClient:
         ``Population.evaluate`` so the species' batched (vmapped) path is
         used when available; singletons fall back to ``get_fitness()``.
         """
-        groups: Dict[str, List[Dict[str, Any]]] = {}
+        # Grouping stays client-side (rather than delegating wholly to
+        # Population.evaluate) so a raising group fails ONLY its own jobs;
+        # the key matches populations._group_by_params: _freeze, collision-
+        # free for numpy-array params, with unhashables isolated.
+        from ..individuals import _freeze
+
+        groups: Dict[Any, List[Dict[str, Any]]] = {}
         for job in jobs:
-            key = repr(sorted((job.get("additional_parameters") or {}).items()))
+            try:
+                key = _freeze(job.get("additional_parameters") or {})
+                hash(key)
+            except TypeError:
+                key = ("__unhashable__", id(job))
             groups.setdefault(key, []).append(job)
 
         for group in groups.values():

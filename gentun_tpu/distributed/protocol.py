@@ -14,7 +14,7 @@ Message types:
 worker → broker       ``hello`` {worker_id, token, capacity}
 broker → worker       ``welcome`` {} | ``error`` {reason}
 worker → broker       ``ready`` {credit}        request up to `credit` jobs
-broker → worker       ``job`` {job_id, genes, additional_parameters}
+broker → worker       ``jobs`` {jobs: [{job_id, genes, additional_parameters}, ...]}
 worker → broker       ``result`` {job_id, fitness}   = the ack (ack-after-work)
 worker → broker       ``fail`` {job_id, reason}      evaluation raised
 worker → broker       ``ping`` {}               liveness, from a side thread
@@ -25,6 +25,12 @@ Delivery semantics (matching AMQP's, SURVEY.md §5 "Failure detection"):
 at-least-once.  A job is requeued when its worker disconnects or stops
 pinging before sending ``result``; the master deduplicates by ``job_id`` and
 keeps the first fitness, so redelivery never double-counts.
+
+Jobs travel in **batches**: every dispatch to a worker is a single ``jobs``
+frame holding everything that worker's credit allows.  This is what makes
+capacity > 1 deterministic — a capacity-8 worker receives its 8 jobs in one
+frame regardless of network latency, so the worker never has to guess (with
+a read timeout) whether more jobs are in flight.
 """
 
 from __future__ import annotations

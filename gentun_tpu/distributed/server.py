@@ -26,7 +26,7 @@ import numpy as np
 
 from ..individuals import Individual
 from ..populations import GridPopulation, Population
-from .broker import JobBroker
+from .broker import GatherTimeout, JobBroker, JobFailed
 
 __all__ = ["DistributedPopulation", "DistributedGridPopulation"]
 
@@ -152,7 +152,36 @@ class DistributedPopulation(Population):
             len(payloads),
             len(pending) - len(payloads),
         )
-        results = self.broker.evaluate(payloads, timeout=self.job_timeout)
+        try:
+            results = self.broker.evaluate(payloads, timeout=self.job_timeout)
+        except JobFailed as e:
+            # Keep the generation's finished work: apply every fitness that
+            # DID come back, then surface the failures.  The broker pruned
+            # its state (attempt counts included), so the defined retry is
+            # simply calling evaluate() again — only the still-unevaluated
+            # (= failed) individuals are reshipped, as fresh jobs.
+            self._apply_results(e.partial, by_id, dup_map)
+            raise JobFailed(
+                f"{len(e.failures)} of {len(payloads)} job(s) failed permanently; "
+                f"{len(e.partial)} successful result(s) were applied. "
+                f"Call evaluate() again to reship only the failed individuals.",
+                failures=e.failures,
+                partial=e.partial,
+            ) from e
+        except GatherTimeout as e:
+            # Straggler timeout: keep whatever finished before the deadline;
+            # a retry (evaluate() again) reships only the unfinished work.
+            self._apply_results(e.partial, by_id, dup_map)
+            raise
+        self._apply_results(results, by_id, dup_map)
+        return len(payloads)
+
+    def _apply_results(
+        self,
+        results: Dict[str, float],
+        by_id: Dict[str, Individual],
+        dup_map: Dict[str, List[Individual]],
+    ) -> None:
         for job_id, fitness in results.items():
             ind = by_id[job_id]
             ind.set_fitness(fitness)
@@ -161,7 +190,6 @@ class DistributedPopulation(Population):
                 self.fitness_cache[key] = float(fitness)
             for dup in dup_map.get(job_id, []):
                 dup.set_fitness(fitness)
-        return len(payloads)
 
     # -- generational continuity ------------------------------------------
 

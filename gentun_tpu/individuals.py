@@ -86,6 +86,10 @@ class Individual:
         else:
             self.genes = self.spec.validate(genes)
         self._fitness: Optional[float] = None
+        # Memo for Population._safe_cache_key: cache_key() can be expensive
+        # (GeneticCnnIndividual canonicalises the DAG) and the population
+        # asks for it several times per generation.
+        self._cache_key_memo: Any = None
 
     # -- genome ------------------------------------------------------------
 
@@ -98,6 +102,7 @@ class Individual:
     def set_genes(self, genes: Mapping[str, Any]) -> None:
         self.genes = self.spec.validate(genes)
         self._fitness = None
+        self._cache_key_memo = None
 
     # -- fitness -----------------------------------------------------------
 
@@ -150,6 +155,7 @@ class Individual:
         if new_genes != self.genes:
             self.genes = new_genes
             self._fitness = None
+            self._cache_key_memo = None
         return self
 
     def reproduce(self, partner: "Individual", rng: Optional[np.random.Generator] = None) -> "Individual":

@@ -32,6 +32,9 @@ logger = logging.getLogger("gentun_tpu")
 #: species whose cache_key() already raised once (log each species once)
 _cache_key_warned: set = set()
 
+#: memo sentinel: this individual's key is known-unusable, don't retry
+_UNCACHEABLE = object()
+
 
 class Population:
     """A fixed-size set of individuals of one species.
@@ -165,15 +168,24 @@ class Population:
 
     @staticmethod
     def _safe_cache_key(ind: Individual):
-        """``ind.cache_key()``, or None (= never cached) if it can't be built.
+        """``ind.cache_key()``, or None (= never cached) if it can't be built
+        or isn't usable as a dict key (hashable).
 
         A failure downgrades the search to cache-less behavior (correct but
         retrains every genome), so the first one per species is logged loudly
-        rather than swallowed.
+        rather than swallowed.  The key is memoized on the individual
+        (invalidated by ``set_genes``/``mutate``): canonicalising a
+        Genetic-CNN DAG is not free, and evaluate() needs the key at several
+        steps per generation.
         """
+        memo = getattr(ind, "_cache_key_memo", None)
+        if memo is not None:
+            return None if memo is _UNCACHEABLE else memo
         try:
-            return ind.cache_key()
+            key = ind.cache_key()
+            hash(key)  # must be usable for dict lookup, not merely built
         except Exception:
+            ind._cache_key_memo = _UNCACHEABLE
             species = type(ind).__name__
             if species not in _cache_key_warned:
                 _cache_key_warned.add(species)
@@ -184,6 +196,8 @@ class Population:
                     exc_info=True,
                 )
             return None
+        ind._cache_key_memo = key
+        return key
 
     def _fill_from_cache(self, pending: List[Individual]) -> List[Individual]:
         """Assign cached fitnesses; return the individuals still unevaluated."""
@@ -206,7 +220,13 @@ class Population:
 
         groups: Dict[Any, List[Individual]] = {}
         for ind in pending:
-            key = _freeze(ind.additional_parameters)
+            try:
+                key = _freeze(ind.additional_parameters)
+                hash(key)
+            except TypeError:
+                # Unhashable config (e.g. a bytearray param): degrade that
+                # individual to its own sequential group instead of crashing.
+                key = ("__unhashable__", id(ind))
             groups.setdefault(key, []).append(ind)
         return list(groups.values())
 

@@ -47,6 +47,20 @@ class AlwaysFails(OneMax):
         raise RuntimeError("boom")
 
 
+class FlakyOneMax(OneMax):
+    """Fails on the all-zero genome for its first two attempts, then heals
+    (worker threads share this process's memory, so the counter is visible)."""
+
+    attempts = 0
+
+    def evaluate(self):
+        if sum(sum(g) for g in self.genes.values()) == 0:
+            FlakyOneMax.attempts += 1
+            if FlakyOneMax.attempts <= 2:
+                raise RuntimeError("flaky boom")
+        return super().evaluate()
+
+
 DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
 
 
@@ -124,6 +138,29 @@ class TestBrokerBasics:
             finally:
                 stop.set()
 
+    def test_capacity_batch_arrives_as_one_frame(self):
+        """Credit-based prefetch: a capacity-8 worker's whole batch arrives in
+        a single `jobs` frame — no drain window, latency-independent."""
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            payloads = {
+                f"j{i}": {"genes": {"S_1": [i]}, "additional_parameters": {}}
+                for i in range(8)
+            }
+            broker.submit(payloads)
+            sock = socket.create_connection(("127.0.0.1", port))
+            rfile = sock.makefile("rb")
+            sock.sendall(encode({"type": "hello", "worker_id": "probe", "capacity": 8}))
+            assert decode(rfile.readline())["type"] == "welcome"
+            sock.sendall(encode({"type": "ready", "credit": 8}))
+            msg = decode(rfile.readline())
+            assert msg["type"] == "jobs"
+            assert len(msg["jobs"]) == 8  # ALL co-delivered jobs, one frame
+            sock.close()
+        finally:
+            broker.stop()
+
     def test_bad_token_rejected(self):
         with DistributedPopulation(OneMax, size=2, seed=0, port=0, password="s3cret") as pop:
             _, port = pop.broker_address
@@ -143,6 +180,63 @@ class TestBrokerBasics:
         with DistributedPopulation(OneMax, size=2, seed=0, port=0, job_timeout=0.3) as pop:
             with pytest.raises(TimeoutError):
                 pop.evaluate()  # no workers connected
+            # timeout prunes + cancels: no state left to leak, and a retry
+            # starts clean (late results would be dropped as stale)
+            time.sleep(0.2)  # let the loop thread process the cancel
+            assert pop.broker._results == {}
+            assert pop.broker._failures == {}
+            assert pop.broker._payloads == {}
+            assert len(pop.broker._pending) == 0  # cancelled ids drained too
+
+    def test_non_ascii_password_accepted(self):
+        """hmac token compare must handle non-ASCII secrets (UTF-8 bytes)."""
+        with DistributedPopulation(
+            OneMax, size=2, seed=0, port=0, password="sécret", job_timeout=10.0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(OneMax, port, password="sécret")
+            try:
+                pop.evaluate()
+                assert all(ind.fitness_evaluated for ind in pop)
+            finally:
+                stop.set()
+
+    def test_fail_fast_when_failure_and_no_workers(self):
+        """A recorded permanent failure + zero connected workers must not
+        hang a timeout-less gather: the barrier fails fast and cancels."""
+        with DistributedPopulation(
+            AlwaysFails, size=3, seed=5, port=0, max_attempts=1, job_timeout=None,
+            heartbeat_timeout=1.0,  # fail-fast waits a full heartbeat window
+        ) as pop:
+            _, port = pop.broker_address
+
+            def fail_one_then_vanish():
+                sock = socket.create_connection(("127.0.0.1", port))
+                rfile = sock.makefile("rb")
+                sock.sendall(encode({"type": "hello", "worker_id": "quitter", "capacity": 1}))
+                assert decode(rfile.readline())["type"] == "welcome"
+                sock.sendall(encode({"type": "ready", "credit": 1}))
+                msg = decode(rfile.readline())
+                job_id = msg["jobs"][0]["job_id"]
+                sock.sendall(encode({"type": "fail", "job_id": job_id, "reason": "boom"}))
+                time.sleep(0.2)  # let the broker record the failure
+                sock.close()  # vanish with 2 jobs still pending, no workers left
+
+            t = threading.Thread(target=fail_one_then_vanish, daemon=True)
+            t.start()
+            done = {}
+
+            def master():
+                try:
+                    pop.evaluate()
+                except JobFailed as e:
+                    done["failures"] = len(e.failures)
+
+            mt = threading.Thread(target=master, daemon=True)
+            mt.start()
+            mt.join(timeout=20.0)
+            assert not mt.is_alive(), "gather hung despite permanent failure + no workers"
+            assert done.get("failures", 0) >= 1
 
     def test_duplicate_result_first_wins(self):
         broker = JobBroker(port=0).start()
@@ -235,6 +329,47 @@ class TestFaultInjection:
             try:
                 with pytest.raises(JobFailed):
                     pop.evaluate()
+                # gather pruned ALL failure state on raise (no leak across
+                # generations, and a resubmit starts with fresh attempts)
+                assert pop.broker._failures == {}
+                assert pop.broker._fail_counts == {}
+            finally:
+                stop.set()
+
+    def test_job_failed_keeps_partial_results_and_retry_reships_only_failures(self):
+        """Post-JobFailed semantics: finished work is applied, evaluate()
+        again reships only the failed individuals (with fresh attempts)."""
+        FlakyOneMax.attempts = 0
+        bad = {"S_1": (0,) * 6, "S_2": (0,) * 6}  # the genome FlakyOneMax chokes on
+        good1 = {"S_1": (1,) * 6, "S_2": (1,) * 6}
+        good2 = {"S_1": (1, 0, 1, 0, 1, 0), "S_2": (0, 1, 0, 1, 0, 1)}
+        inds = [
+            FlakyOneMax(genes=g, additional_parameters={"nodes": (4, 4)})
+            for g in (good1, bad, good2)
+        ]
+        with DistributedPopulation(
+            FlakyOneMax,
+            individual_list=inds,
+            additional_parameters={"nodes": (4, 4)},
+            port=0,
+            max_attempts=2,
+            job_timeout=30.0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(FlakyOneMax, port)
+            try:
+                with pytest.raises(JobFailed) as ei:
+                    pop.evaluate()
+                # the two healthy individuals kept their results
+                assert pop[0].fitness_evaluated and pop[2].fitness_evaluated
+                assert not pop[1].fitness_evaluated
+                assert len(ei.value.failures) == 1
+                assert len(ei.value.partial) == 2
+                # retry: only the failed individual is reshipped; FlakyOneMax
+                # has burnt its 2 failures and now succeeds
+                shipped = pop.evaluate()
+                assert shipped == 1
+                assert pop[1].get_fitness() == 0.0
             finally:
                 stop.set()
 

@@ -190,3 +190,34 @@ class TestGroupwiseBatching:
         pop.evaluate()
         # The cache key includes additional_parameters: both train.
         assert sum(n for n, _ in CountingBatchModel.calls) == 2
+
+
+class TestUnhashableConfigDegrades:
+    def test_unhashable_additional_parameters_still_evaluate(self):
+        """Unhashable params (e.g. a bytearray) must degrade to cache-less,
+        sequential evaluation — not crash Population.evaluate()."""
+        CountingEval.calls = 0
+        data = np.zeros(1)
+        params = {"nodes": (3,), "mask": bytearray(b"x")}  # unhashable value
+        inds = [
+            CountingEval(x_train=data, y_train=data, genes={"S_1": (1, 0, 1)},
+                         additional_parameters=dict(params)),
+            CountingEval(x_train=data, y_train=data, genes={"S_1": (1, 0, 1)},
+                         additional_parameters=dict(params)),
+        ]
+        pop = Population(CountingEval, x_train=data, y_train=data,
+                         individual_list=inds, additional_parameters=dict(params))
+        pop.evaluate()
+        assert all(ind.fitness_evaluated for ind in pop)
+        # no cache/dedup possible: both train
+        assert CountingEval.calls == 2
+
+    def test_cache_key_memo_invalidated_by_mutation(self):
+        data = np.zeros(1)
+        ind = CountingEval(x_train=data, y_train=data, genes={"S_1": (0, 0, 0)},
+                           additional_parameters={"nodes": (3,)})
+        k1 = Population._safe_cache_key(ind)
+        assert Population._safe_cache_key(ind) is ind._cache_key_memo  # memo hit
+        ind.set_genes({"S_1": (1, 1, 1)})
+        k2 = Population._safe_cache_key(ind)
+        assert k1 != k2

@@ -39,10 +39,14 @@ def test_genetic_cnn_search_end_to_end():
 
     assert 0.4 < best.get_fitness() <= 1.0
     assert len(ga.history) == 2
-    # every generation evaluated the whole population through the batched path
     for rec in ga.history:
         assert rec["population_size"] == 4
-        assert rec["individuals_per_hour_per_chip"] > 0
+        # the metric counts only individuals that actually hit the compute
+        # path; a generation that is 100% fitness-cache hits legitimately
+        # reports 0 (cache hits cost ~0 wall time, not inflated throughput)
+        assert rec["individuals_per_hour_per_chip"] >= 0
+    # generation 0 has no cache yet: the whole population trains for real
+    assert ga.history[0]["individuals_per_hour_per_chip"] > 0
     # elitism: best fitness is monotone non-decreasing across generations
     fits = [rec["best_fitness"] for rec in ga.history]
     assert fits == sorted(fits)
