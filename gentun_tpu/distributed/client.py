@@ -174,6 +174,8 @@ class GentunClient:
             # its whole batch in a single blocking read — no drain window, no
             # read timeouts through the buffered reader, and the batch trains
             # as one vmapped program whatever the network latency was.
+            # (Batches near the protocol size cap arrive split into several
+            # frames, trained one frame per loop iteration — see protocol.py.)
             self._evaluate_batch(self._await_jobs())
 
     def _await_jobs(self) -> List[Dict[str, Any]]:

@@ -26,11 +26,15 @@ at-least-once.  A job is requeued when its worker disconnects or stops
 pinging before sending ``result``; the master deduplicates by ``job_id`` and
 keeps the first fitness, so redelivery never double-counts.
 
-Jobs travel in **batches**: every dispatch to a worker is a single ``jobs``
+Jobs travel in **batches**: a dispatch to a worker is a single ``jobs``
 frame holding everything that worker's credit allows.  This is what makes
 capacity > 1 deterministic — a capacity-8 worker receives its 8 jobs in one
 frame regardless of network latency, so the worker never has to guess (with
-a read timeout) whether more jobs are in flight.
+a read timeout) whether more jobs are in flight.  One bounded exception: a
+batch whose encoded size would approach ``MAX_MESSAGE_BYTES`` is split at a
+soft size cap into several consecutive ``jobs`` frames, which the worker
+consumes (and trains) one frame at a time — batching degrades gracefully
+for pathologically large payloads instead of breaking the protocol.
 """
 
 from __future__ import annotations

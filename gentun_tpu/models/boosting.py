@@ -11,12 +11,19 @@ interface pluggable so a real xgboost backend can drop in unchanged.
 Genome keys are the sklearn constructor names (see
 :func:`gentun_tpu.genes.boosting_genome`); xgboost-style keys (from
 :func:`gentun_tpu.genes.xgboost_genome`) are translated where an equivalent
-exists and ignored otherwise, so reference-shaped genomes still run.
+exists — for the reference's 11-gene genome, 7 stay live
+(colsample_bytree/bylevel fold into ``max_features``, ``scale_pos_weight``
+into ``class_weight``; ``alpha`` maps to ``l2_regularization`` only in
+genomes without a competing ``lambda``, so it is inert in the reference
+genome) — and every inert gene triggers ONE loud warning stating the
+effective search dimensionality, so a reference genome never searches
+silently-dead dimensions.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Mapping
+import logging
+from typing import Any, Dict, Mapping, Tuple
 
 import numpy as np
 
@@ -24,14 +31,26 @@ from .generic import GentunModel
 
 __all__ = ["BoostingModel"]
 
-# xgboost name → (sklearn name, converter); best-effort translation for
-# reference-shaped genomes (gentun XgboostIndividual [PUB]).
+logger = logging.getLogger("gentun_tpu")
+
+# xgboost name → (sklearn name, converter); translation for reference-shaped
+# genomes (gentun XgboostIndividual [PUB]).  Of the 11 reference genes, 7 map
+# onto HistGradientBoosting knobs (colsample_* jointly onto max_features,
+# scale_pos_weight onto class_weight for binary classification); the rest —
+# gamma, subsample, max_delta_step, and alpha whenever lambda is also present
+# — have NO sklearn equivalent and are reported loudly as inert (see
+# _warn_inert), never silently dropped.
 _XGB_TO_SKLEARN = {
     "eta": ("learning_rate", float),
     "max_depth": ("max_depth", int),
     "lambda": ("l2_regularization", float),
     "min_child_weight": ("min_samples_leaf", lambda v: max(1, int(round(v)))),
 }
+
+#: xgboost genes with no HistGradientBoosting counterpart at all (documented
+#: here for readers; translation-wise they land in the same inert bucket as
+#: any unknown knob)
+_XGB_INERT = {"gamma", "subsample", "max_delta_step"}
 
 _SKLEARN_KEYS = {
     "learning_rate",
@@ -41,24 +60,86 @@ _SKLEARN_KEYS = {
     "l2_regularization",
     "max_bins",
     "max_iter",
+    "max_features",
 }
 
+#: inert-gene sets already warned about (one loud warning per distinct set)
+_inert_warned: set = set()
 
-def _genes_to_params(genes: Mapping[str, Any]) -> Dict[str, Any]:
+
+def _warn_inert(inert: Tuple[str, ...], total: int) -> None:
+    if not inert or inert in _inert_warned:
+        return
+    _inert_warned.add(inert)
+    logger.warning(
+        "xgboost genome translation: %d of %d gene(s) have no sklearn "
+        "HistGradientBoosting equivalent and are INERT in this search: %s. "
+        "The effective search dimensionality is %d, not %d.  Install a real "
+        "xgboost backend (the model interface is pluggable) for the full "
+        "reference space.",
+        len(inert), total, ", ".join(inert), total - len(inert), total,
+    )
+
+
+def _genes_to_params(
+    genes: Mapping[str, Any],
+    task: str = "classification",
+    classes: Any = None,
+) -> Dict[str, Any]:
+    """Genome dict → HistGradientBoosting constructor kwargs.
+
+    ``classes`` (``np.unique(y_train)``) lets ``scale_pos_weight`` target the
+    dataset's actual positive class; without it, integer labels {0, 1} are
+    assumed.
+    """
     params: Dict[str, Any] = {}
+    inert = []
+    colsample = 1.0
+    has_colsample = False
     for name, value in genes.items():
         if name in _SKLEARN_KEYS:
-            params[name] = int(value) if name != "learning_rate" and name != "l2_regularization" else float(value)
+            params[name] = (
+                float(value)
+                if name in ("learning_rate", "l2_regularization", "max_features")
+                else int(value)
+            )
+        elif name in ("colsample_bytree", "colsample_bylevel"):
+            # xgboost applies tree- and level-wise column subsampling
+            # multiplicatively; sklearn has one per-split `max_features`
+            # fraction, so the product is the faithful joint mapping.
+            colsample *= float(value)
+            has_colsample = True
+        elif name == "scale_pos_weight":
+            # xgboost semantics: up-weight the POSITIVE class of a binary
+            # task.  sklearn's HistGradientBoosting applies a class_weight
+            # dict to LABEL-ENCODED classes (0..K-1, verified on sklearn
+            # 1.9: original-label keys raise "classes not in class_weight"),
+            # so {0: 1, 1: w} up-weights the second sorted class — the
+            # positive one under xgboost's 0/1, {-1,1}, or {1,2} conventions
+            # — for every binary label encoding.  `classes` only decides
+            # whether the task is binary at all.
+            n_classes = 2 if classes is None else len(np.asarray(classes))
+            if task == "classification" and n_classes == 2:
+                params["class_weight"] = {0: 1.0, 1: float(value)}
+            else:
+                inert.append(name)  # regression / multiclass: no equivalent
+        elif name == "alpha":
+            # L1 regularization has no sklearn knob; fold into l2 only when
+            # the genome has no lambda of its own (approximate, but keeps
+            # the gene live rather than inert).
+            if "lambda" not in genes and "l2_regularization" not in genes:
+                params["l2_regularization"] = float(value)
+            else:
+                inert.append(name)
         elif name in _XGB_TO_SKLEARN:
             target, conv = _XGB_TO_SKLEARN[name]
             params.setdefault(target, conv(value))
-        # other xgboost-only knobs (gamma, subsample, ...) have no sklearn
-        # HistGradientBoosting equivalent; they are ignored, not an error,
-        # so reference genomes remain runnable.
-    if "learning_rate" in params:
-        params["learning_rate"] = float(params["learning_rate"])
-    if "max_depth" in params:
-        params["max_depth"] = int(params["max_depth"])
+        else:
+            inert.append(name)  # known-inert (_XGB_INERT) or unknown knob:
+            # surface it, don't hide it
+    if has_colsample:
+        params["max_features"] = min(1.0, max(0.05, colsample))
+    _warn_inert(tuple(sorted(inert)), len(genes))
     return params
 
 
@@ -107,7 +188,11 @@ class BoostingModel(GentunModel):
             HistGradientBoostingRegressor,
         )
 
-        params = _genes_to_params(self.genes)
+        params = _genes_to_params(
+            self.genes,
+            task=self.task,
+            classes=np.unique(self.y_train) if self.task == "classification" else None,
+        )
         cls = (
             HistGradientBoostingClassifier
             if self.task == "classification"

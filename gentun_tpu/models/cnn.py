@@ -73,6 +73,13 @@ class MaskedGeneticCnn(nn.Module):
     default output node sums exit-node outputs (identity pass-through when
     the stage decodes empty); 2×2 max-pool closes the stage.  Head:
     Dense(dense_units)+ReLU → Dropout → Dense(n_classes), logits in float32.
+
+    ``stage_exit_conv=True`` switches to the Xie & Yuille variant where the
+    default OUTPUT node applies its own Conv3×3(F_s)+ReLU after the sum
+    (ADVICE r1: most Genetic-CNN implementations do; the default stays off
+    to preserve round-1 behavior).  The conv is applied unconditionally to
+    the merged stage output — shape-static, so one compiled program and the
+    population vmap are preserved.
     """
 
     nodes: Tuple[int, ...]
@@ -81,6 +88,7 @@ class MaskedGeneticCnn(nn.Module):
     n_classes: int = 10
     dropout_rate: float = 0.5
     compute_dtype: Any = jnp.bfloat16
+    stage_exit_conv: bool = False
 
     @nn.compact
     def __call__(self, x, masks, train: bool = False):
@@ -113,6 +121,8 @@ class MaskedGeneticCnn(nn.Module):
                 x = has_active * out + (1.0 - has_active) * a0
             else:
                 x = a0
+            if self.stage_exit_conv:
+                x = nn.relu(conv(name=f"stage{s}_exit")(x))
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(self.dense_units, dtype=dtype)(x))
@@ -149,6 +159,7 @@ def _population_cv_fn(
     n_train: int,
     n_val_padded: int,
     fold_parallel: bool,
+    stage_exit_conv: bool,
 ):
     model = MaskedGeneticCnn(
         nodes=nodes,
@@ -157,6 +168,7 @@ def _population_cv_fn(
         n_classes=n_classes,
         dropout_rate=dropout_rate,
         compute_dtype=jnp.dtype(compute_dtype),
+        stage_exit_conv=stage_exit_conv,
     )
     steps_per_epoch = n_train // batch_size
     if steps_per_epoch == 0:
@@ -302,6 +314,7 @@ class GeneticCnnModel(GentunModel):
         mesh="auto",
         cache_dir: Optional[str] = None,
         fold_parallel: bool = False,
+        stage_exit_conv: bool = False,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -322,6 +335,7 @@ class GeneticCnnModel(GentunModel):
             mesh=mesh,
             cache_dir=cache_dir,
             fold_parallel=bool(fold_parallel),
+            stage_exit_conv=bool(stage_exit_conv),
         )
 
     def cross_validate(self) -> float:
@@ -377,6 +391,7 @@ class GeneticCnnModel(GentunModel):
             n_classes=cfg["n_classes"],
             dropout_rate=cfg["dropout_rate"],
             compute_dtype=jnp.dtype(cfg["compute_dtype"]),
+            stage_exit_conv=bool(cfg["stage_exit_conv"]),
         )
 
         kfold = cfg["kfold"]
@@ -416,6 +431,7 @@ class GeneticCnnModel(GentunModel):
             n_tr,
             n_val_padded,
             bool(cfg["fold_parallel"]),
+            bool(cfg["stage_exit_conv"]),
         )
 
         # Per-fold index arrays (host-side numpy, tiny): the fold IS its
@@ -487,6 +503,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         mesh="auto",
         cache_dir=None,
         fold_parallel=False,
+        stage_exit_conv=False,
     )
     unknown = set(config) - set(defaults)
     if unknown:

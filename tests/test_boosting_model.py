@@ -32,10 +32,72 @@ def test_genes_translate_sklearn_names():
 def test_genes_translate_xgboost_names():
     genes = xgboost_genome().default()
     params = _genes_to_params(genes)
-    # eta→learning_rate, lambda→l2_regularization; unknown knobs dropped
+    # eta→learning_rate, lambda→l2_regularization; inert knobs excluded
     assert params["learning_rate"] == pytest.approx(0.3)
     assert params["l2_regularization"] == pytest.approx(1.0)
     assert "gamma" not in params and "subsample" not in params
+
+
+def test_xgboost_colsample_and_pos_weight_stay_live():
+    """VERDICT r1 #9: colsample_* → max_features (product), scale_pos_weight
+    → class_weight, alpha → l2 when lambda absent — live, not dropped."""
+    params = _genes_to_params(
+        {"colsample_bytree": 0.8, "colsample_bylevel": 0.5, "scale_pos_weight": 3.0},
+        task="classification",
+    )
+    assert params["max_features"] == pytest.approx(0.4)
+    assert params["class_weight"] == {0: 1.0, 1: 3.0}
+    # alpha folds into l2 only without a competing lambda
+    assert _genes_to_params({"alpha": 2.0})["l2_regularization"] == pytest.approx(2.0)
+    assert _genes_to_params({"alpha": 2.0, "lambda": 1.0})["l2_regularization"] == pytest.approx(1.0)
+    # regression: scale_pos_weight has no equivalent → inert, excluded
+    assert "class_weight" not in _genes_to_params({"scale_pos_weight": 3.0}, task="regression")
+    # HGB applies class_weight to LABEL-ENCODED classes: {0,1} keys work for
+    # any binary encoding (the second sorted class is the positive one)
+    cw = _genes_to_params({"scale_pos_weight": 5.0}, classes=np.array([-1, 1]))["class_weight"]
+    assert cw == {0: 1.0, 1: 5.0}
+    # multiclass: no single positive class → inert
+    assert "class_weight" not in _genes_to_params(
+        {"scale_pos_weight": 5.0}, classes=np.array([0, 1, 2])
+    )
+
+
+def test_scale_pos_weight_trains_on_non01_labels():
+    """End-to-end regression: {1,2} labels + scale_pos_weight must fit."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 4))
+    y = (x[:, 0] > 0).astype(np.int64) + 1  # labels {1, 2}
+    genes = {"eta": 0.3, "max_depth": 5, "lambda": 1.0, "scale_pos_weight": 2.0}
+    acc = BoostingModel(x, y, genes, kfold=3, seed=0).cross_validate()
+    assert acc > 0.7
+
+
+def test_inert_genes_warn_loudly(caplog):
+    """No silently-inert genes: translation states effective dimensionality."""
+    import logging
+
+    from gentun_tpu.models import boosting as boosting_mod
+
+    boosting_mod._inert_warned.clear()
+    with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+        _genes_to_params(xgboost_genome().default())
+    joined = " ".join(r.getMessage() for r in caplog.records)
+    assert "INERT" in joined
+    for name in ("gamma", "subsample", "max_delta_step"):
+        assert name in joined
+    # one warning per distinct inert set, not one per individual
+    n = len(caplog.records)
+    _genes_to_params(xgboost_genome().default())
+    assert len(caplog.records) == n
+
+
+def test_full_xgboost_genome_trains(tabular_data):
+    """A reference-shaped 11-gene genome runs end-to-end on the sklearn
+    backend with 8 of 11 genes live."""
+    x, y = tabular_data
+    genes = xgboost_genome().default()
+    acc = BoostingModel(x, y, genes, kfold=3, seed=0).cross_validate()
+    assert 0.6 < acc <= 1.0
 
 
 def test_cross_validate_classification(tabular_data):

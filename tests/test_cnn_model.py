@@ -180,3 +180,30 @@ class TestGeneticCnnModelCV:
             batch_size=32, dense_units=32, compute_dtype="float32", seed=1,
         )
         assert 0.0 <= m.cross_validate() <= 1.0
+
+
+class TestStageExitConv:
+    """Optional Xie & Yuille output-node conv (ADVICE r1, cnn.py stage exit)."""
+
+    def test_exit_conv_params_exist_and_forward_works(self):
+        model = MaskedGeneticCnn(
+            nodes=(3,), filters=(4,), dense_units=8, n_classes=2,
+            compute_dtype=jnp.float32, stage_exit_conv=True,
+        )
+        masks = _masks_for({"S_1": (1, 0, 1)}, (3,))
+        x = jnp.ones((2, 8, 8, 1))
+        params = model.init(jax.random.PRNGKey(0), x, masks)
+        assert "stage0_exit" in params["params"]
+        out = model.apply(params, x, masks)
+        assert out.shape == (2, 2)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_population_path_trains_with_exit_conv(self, separable_data):
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (0, 0, 0)}]
+        accs = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, **{**FAST, "stage_exit_conv": True}
+        )
+        assert accs.shape == (2,)
+        assert np.isfinite(accs).all()
+        assert (accs > 0.25).all()  # beats 4-class chance

@@ -477,6 +477,58 @@ class TestMasterSideDedup:
                 stop.set()
 
 
+class TestMasterCrashResume:
+    """SURVEY.md §5: 'Master death is unrecoverable' in the reference — the
+    rebuild beats it: checkpoint + DistributedPopulation survive a master
+    crash, workers reconnect to the reborn master, and the completed search
+    is bit-compatible with an uninterrupted one (VERDICT r1 item #8)."""
+
+    def test_master_crash_resume_completes_bit_compatibly(self, tmp_path):
+        from gentun_tpu.utils import Checkpointer
+
+        path = str(tmp_path / "distributed-ckpt.json")
+        # A FIXED port (picked free) so the surviving worker's reconnect
+        # loop can find the reborn master; ephemeral port=0 would change.
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        # Uninterrupted reference: single-process, same seeds (OneMax fitness
+        # is pure, so local and remote evaluation agree exactly).
+        ga_full = GeneticAlgorithm(Population(OneMax, *DATA, size=6, seed=42), seed=7)
+        ga_full.run(5)
+
+        # Act 1: distributed master + worker; checkpoint; crash after gen 2.
+        pop_a = DistributedPopulation(OneMax, size=6, seed=42, host="127.0.0.1", port=port)
+        stop, _ = _start_worker_thread(OneMax, port)
+        try:
+            ga_a = GeneticAlgorithm(pop_a, seed=7)
+            ga_a.set_checkpointer(Checkpointer(path))
+            ga_a.run(2)
+        finally:
+            # the "crash": broker listener dies with the master process;
+            # the worker survives and enters its reconnect loop
+            ga_a.population.close()
+            pop_a.close()
+        del ga_a, pop_a
+
+        # Act 2: reborn master on the SAME port resumes from the checkpoint.
+        pop_b = DistributedPopulation(OneMax, size=6, seed=0, host="127.0.0.1", port=port)
+        try:
+            ga_b = GeneticAlgorithm(pop_b, seed=0)
+            assert Checkpointer(path).resume(ga_b)
+            assert ga_b.generation == 2
+            ga_b.run(3)  # worker reconnected and served these generations
+            full = [(ind.get_genes(), ind.get_fitness()) for ind in ga_full.population]
+            resumed = [(ind.get_genes(), ind.get_fitness()) for ind in ga_b.population]
+            assert full == resumed
+        finally:
+            ga_b.population.close()
+            pop_b.close()
+            stop.set()
+
+
 class TestBrokerOwnership:
     def test_close_on_clone_stops_embedded_broker(self):
         pop = DistributedPopulation(OneMax, size=2, seed=0, port=0)
