@@ -157,6 +157,7 @@ class GeneticAlgorithm:
             "best_fitness": fittest.get_fitness(),
             "best_genes": fittest.get_genes(),
             "population_size": len(self.population),
+            "evaluated": int(evaluated),  # individuals that actually trained
             "eval_wall_s": round(elapsed_s, 3),
             # the north-star metric (BASELINE.json): individuals/hour/chip
             "individuals_per_hour_per_chip": round(evaluated / (elapsed_s / 3600.0) / n_chips, 2),

@@ -63,21 +63,35 @@ _SKLEARN_KEYS = {
     "max_features",
 }
 
-#: inert-gene sets already warned about (one loud warning per distinct set)
+#: inert/shadowed-gene sets already warned about (one loud warning per set)
 _inert_warned: set = set()
 
 
-def _warn_inert(inert: Tuple[str, ...], total: int) -> None:
-    if not inert or inert in _inert_warned:
+def _warn_inert(inert: Tuple[str, ...], shadowed: Tuple[str, ...], total: int) -> None:
+    if (not inert and not shadowed) or (inert, shadowed) in _inert_warned:
         return
-    _inert_warned.add(inert)
+    _inert_warned.add((inert, shadowed))
+    dead = len(inert) + len(shadowed)
+    parts = []
+    if inert:
+        parts.append(
+            f"{len(inert)} with no sklearn HistGradientBoosting equivalent "
+            f"(INERT): {', '.join(inert)}"
+        )
+    if shadowed:
+        # These DO have an equivalent — another gene in the same genome
+        # claimed the knob (e.g. eta vs learning_rate, alpha vs lambda).
+        # Remove the duplicate key to make them live, don't drop them.
+        parts.append(
+            f"{len(shadowed)} SHADOWED by a competing gene for the same "
+            f"knob: {', '.join(shadowed)}"
+        )
     logger.warning(
-        "xgboost genome translation: %d of %d gene(s) have no sklearn "
-        "HistGradientBoosting equivalent and are INERT in this search: %s. "
-        "The effective search dimensionality is %d, not %d.  Install a real "
-        "xgboost backend (the model interface is pluggable) for the full "
-        "reference space.",
-        len(inert), total, ", ".join(inert), total - len(inert), total,
+        "xgboost genome translation: %d of %d gene(s) are dead in this "
+        "search — %s. The effective search dimensionality is %d, not %d.  "
+        "Install a real xgboost backend (the model interface is pluggable) "
+        "for the full reference space.",
+        dead, total, "; ".join(parts), total - dead, total,
     )
 
 
@@ -94,8 +108,12 @@ def _genes_to_params(
     """
     params: Dict[str, Any] = {}
     inert = []
+    shadowed = []
     colsample = 1.0
-    has_colsample = False
+    colsample_genes = []
+    # Pass 1: sklearn-named genes bind first, so in a mixed genome an
+    # explicit sklearn key deterministically wins over its xgboost twin
+    # (which is then reported shadowed) regardless of dict order.
     for name, value in genes.items():
         if name in _SKLEARN_KEYS:
             params[name] = (
@@ -103,12 +121,15 @@ def _genes_to_params(
                 if name in ("learning_rate", "l2_regularization", "max_features")
                 else int(value)
             )
-        elif name in ("colsample_bytree", "colsample_bylevel"):
+    for name, value in genes.items():
+        if name in _SKLEARN_KEYS:
+            continue
+        if name in ("colsample_bytree", "colsample_bylevel"):
             # xgboost applies tree- and level-wise column subsampling
             # multiplicatively; sklearn has one per-split `max_features`
             # fraction, so the product is the faithful joint mapping.
             colsample *= float(value)
-            has_colsample = True
+            colsample_genes.append(name)
         elif name == "scale_pos_weight":
             # xgboost semantics: up-weight the POSITIVE class of a binary
             # task.  sklearn's HistGradientBoosting applies a class_weight
@@ -126,20 +147,28 @@ def _genes_to_params(
         elif name == "alpha":
             # L1 regularization has no sklearn knob; fold into l2 only when
             # the genome has no lambda of its own (approximate, but keeps
-            # the gene live rather than inert).
+            # the gene live rather than dead).
             if "lambda" not in genes and "l2_regularization" not in genes:
                 params["l2_regularization"] = float(value)
             else:
-                inert.append(name)
+                shadowed.append(name)  # lambda claimed the l2 knob
         elif name in _XGB_TO_SKLEARN:
             target, conv = _XGB_TO_SKLEARN[name]
-            params.setdefault(target, conv(value))
+            if target in params:
+                shadowed.append(name)  # its sklearn twin claimed the knob
+            else:
+                params[target] = conv(value)
         else:
             inert.append(name)  # known-inert (_XGB_INERT) or unknown knob:
             # surface it, don't hide it
-    if has_colsample:
-        params["max_features"] = min(1.0, max(0.05, colsample))
-    _warn_inert(tuple(sorted(inert)), len(genes))
+    if colsample_genes:
+        if "max_features" in params:
+            # An explicit sklearn max_features gene won in pass 1; the
+            # colsample twins lose and are reported, never silently merged.
+            shadowed.extend(colsample_genes)
+        else:
+            params["max_features"] = min(1.0, max(0.05, colsample))
+    _warn_inert(tuple(sorted(inert)), tuple(sorted(shadowed)), len(genes))
     return params
 
 

@@ -29,11 +29,21 @@ TPU-first architecture (NOT how the reference does it — SURVEY.md §7
 - Static shapes everywhere: fold sizes are equalised by trimming, train
   batches are a precomputed ``(steps, batch)`` index array consumed by
   ``lax.scan``, eval uses padded index batches with 0/1 weights.
-- **The k-fold axis is batched too** (SURVEY.md §7 "hard parts" #3): the
-  dataset lives on device ONCE and folds are expressed as index arrays, so
-  all ``kfold`` folds of all ``P`` genomes train inside a single XLA
-  program — a ``vmap(fold) ∘ vmap(pop)`` nest whose matmuls are
-  ``kfold·P``-wide.  No per-fold host round-trips, no per-fold transfers.
+- **The k-fold axis stays on device** (SURVEY.md §7 "hard parts" #3): the
+  dataset lives on device ONCE and folds are expressed as index arrays —
+  no per-fold host round-trips, no per-fold transfers.  With
+  ``fold_parallel=True`` all folds of all genomes train inside a single
+  fused XLA program (``vmap(fold) ∘ vmap(pop)``).
+- **Segmented execution by default**: long schedules run as a host loop of
+  bounded-length jitted calls (``segment_steps`` ≈ tens of seconds each)
+  over device-resident carries — params, optimizer state, and the dropout
+  rng never leave the device, and the optax schedule continues across
+  segments via the opt-state step count.  One multi-minute XLA execution
+  is exactly what trips runtime watchdogs on tunneled TPU runtimes (a
+  full-schedule 3875-step single program reproducibly killed the axon TPU
+  worker on this host); segmenting bounds every execution while keeping
+  the population axis vmapped, so MXU utilisation is unchanged and the
+  per-call dispatch overhead (~ms against ~tens of seconds) is noise.
 """
 
 from __future__ import annotations
@@ -143,8 +153,7 @@ class MaskedGeneticCnn(nn.Module):
 # compiles exactly once per (config, fold-shape) pair.
 
 
-@functools.lru_cache(maxsize=32)
-def _population_cv_fn(
+def _training_primitives(
     nodes: Tuple[int, ...],
     filters: Tuple[int, ...],
     dense_units: int,
@@ -158,9 +167,16 @@ def _population_cv_fn(
     batch_size: int,
     n_train: int,
     n_val_padded: int,
-    fold_parallel: bool,
     stage_exit_conv: bool,
 ):
+    """Shared, unjitted builders both executors compose: the model, the
+    optimizer (staged-LR SGD), a train-segment function, and the fold eval.
+
+    There is exactly ONE definition of the schedule-boundary math, the loss,
+    and the eval weighting — the fused (:func:`_population_cv_fn`) and
+    segmented (:func:`_fold_segment_fns`) paths differ only in how the
+    fold/step axes are driven, never in what a step computes.
+    """
     model = MaskedGeneticCnn(
         nodes=nodes,
         filters=filters,
@@ -193,28 +209,26 @@ def _population_cv_fn(
         )
         return optax.softmax_cross_entropy_with_integer_labels(logits, batch_y).mean()
 
-    def train_one(params, masks, x_full, y_full, val_idx, val_weight, batch_idx, rng):
-        """Full train + eval for ONE (fold, individual) pair (double-vmapped).
-
-        The dataset arrives whole (``x_full``); the fold is expressed purely
-        as index arrays (``batch_idx`` gathers train batches, ``val_idx``
-        gathers the held-out fold), so every fold shares the device-resident
-        data and all folds train concurrently.
-        """
-        opt_state = tx.init(params)
+    def train_segment(params, opt_state, masks, x_full, y_full, batch_idx_seg, rng):
+        """Scan any number of train steps; carries advance, schedule
+        position rides the opt-state step count."""
 
         def step(carry, idx_b):
             params, opt_state, rng = carry
             rng, dropout_rng = jax.random.split(rng)
             batch_x = jnp.take(x_full, idx_b, axis=0)
             batch_y = jnp.take(y_full, idx_b, axis=0)
-            loss, grads = jax.value_and_grad(loss_fn)(params, masks, batch_x, batch_y, dropout_rng)
+            _, grads = jax.value_and_grad(loss_fn)(params, masks, batch_x, batch_y, dropout_rng)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state, rng), loss
+            return (params, opt_state, rng), None
 
-        (params, _, _), losses = jax.lax.scan(step, (params, opt_state, rng), batch_idx)
+        (params, opt_state, rng), _ = jax.lax.scan(
+            step, (params, opt_state, rng), batch_idx_seg
+        )
+        return params, opt_state, rng
 
+    def eval_fold(params, masks, x_full, y_full, val_idx, val_weight):
         def eval_batch(correct, start):
             idx_b = jax.lax.dynamic_slice_in_dim(val_idx, start, batch_size, axis=0)
             wb = jax.lax.dynamic_slice_in_dim(val_weight, start, batch_size, axis=0)
@@ -226,35 +240,182 @@ def _population_cv_fn(
 
         starts = jnp.arange(0, n_val_padded, batch_size)
         correct, _ = jax.lax.scan(eval_batch, jnp.float32(0.0), starts)
-        acc = correct / jnp.maximum(val_weight.sum(), 1.0)
-        return acc, losses[-1]
+        return correct / jnp.maximum(val_weight.sum(), 1.0)
+
+    return model, tx, train_segment, eval_fold
+
+
+@functools.lru_cache(maxsize=32)
+def _population_cv_fn(*static_key):
+    """FUSED executor (``fold_parallel=True``): one XLA program trains all
+    folds of all genomes concurrently — ``vmap(fold) ∘ vmap(pop)`` with
+    ``kfold·P``-wide matmuls.  Maximum parallelism, kfold× the working set,
+    and one long device execution; prefer it when pop×kfold is small or the
+    runtime has no execution-time watchdog.  Static key =
+    :func:`_training_primitives` args.
+    """
+    _, tx, train_segment, eval_fold = _training_primitives(*static_key)
+
+    def train_one(params, masks, x_full, y_full, val_idx, val_weight, batch_idx, rng):
+        opt_state = tx.init(params)
+        params, _, _ = train_segment(params, opt_state, masks, x_full, y_full, batch_idx, rng)
+        return eval_fold(params, masks, x_full, y_full, val_idx, val_weight)
 
     # Inner vmap — population axis: params, masks, rng per-individual; the
-    # dataset and this fold's index arrays are shared across the population.
+    # dataset and the fold's index arrays are shared across the population.
     over_pop = jax.vmap(train_one, in_axes=(0, 0, None, None, None, None, None, 0))
-
-    # Outer fold axis — params, rng, and the fold index arrays are per-fold;
-    # masks (the genomes) and the dataset are shared across folds.  Two
-    # strategies, both single-program with the dataset resident on device:
-    #
-    # - ``vmap``: all folds train concurrently.  Maximum parallelism, but the
-    #   live working set is kfold× larger — best when pop×kfold is small.
-    # - ``map`` (lax.map = scan): folds run sequentially *inside* the program.
-    #   The population axis already saturates the MXU for real population
-    #   sizes, and the smaller working set avoids HBM spills.  Default.
-    if fold_parallel:
-        over_folds = jax.vmap(over_pop, in_axes=(0, None, None, None, 0, 0, 0, 0))
-    else:
-
-        def over_folds(params, masks, x_full, y_full, val_idx, val_weight, batch_idx, rng):
-            return jax.lax.map(
-                lambda per_fold: over_pop(
-                    per_fold[0], masks, x_full, y_full, per_fold[1], per_fold[2], per_fold[3], per_fold[4]
-                ),
-                (params, val_idx, val_weight, batch_idx, rng),
-            )
-
+    # Outer vmap — fold axis: params, rng, index arrays per-fold; masks and
+    # the dataset shared.
+    over_folds = jax.vmap(over_pop, in_axes=(0, None, None, None, 0, 0, 0, 0))
     return jax.jit(over_folds)
+
+
+@functools.lru_cache(maxsize=32)
+def _fold_segment_fns(
+    nodes: Tuple[int, ...],
+    filters: Tuple[int, ...],
+    dense_units: int,
+    n_classes: int,
+    dropout_rate: float,
+    compute_dtype: str,
+    epochs: Tuple[int, ...],
+    learning_rate: Tuple[float, ...],
+    momentum: float,
+    nesterov: bool,
+    batch_size: int,
+    n_train: int,
+    n_val_padded: int,
+    stage_exit_conv: bool,
+):
+    """Per-fold building blocks for SEGMENTED execution (the default path).
+
+    Returns ``(init_pop, train_pop, eval_pop)``, each jitted with the
+    population axis vmapped:
+
+    - ``init_pop(params) -> opt_state``
+    - ``train_pop(params, opt_state, masks, x, y, batch_idx_seg, rng)``
+      runs one bounded segment of train steps and returns the advanced
+      carries; the optax schedule continues across segments through the
+      opt-state step count, so chopping the schedule is semantically
+      invisible.
+    - ``eval_pop(params, masks, x, y, val_idx, val_weight) -> acc``
+
+    Same lru-cached-by-static-config pattern as :func:`_population_cv_fn`;
+    the two factories share :func:`_training_primitives`, differing only in
+    how the fold/step axes are driven (fused vmap vs host loop).
+    """
+    _, tx, train_segment, eval_fold = _training_primitives(
+        nodes,
+        filters,
+        dense_units,
+        n_classes,
+        dropout_rate,
+        compute_dtype,
+        epochs,
+        learning_rate,
+        momentum,
+        nesterov,
+        batch_size,
+        n_train,
+        n_val_padded,
+        stage_exit_conv,
+    )
+    init_pop = jax.jit(jax.vmap(tx.init))
+    # Donate the carries: each call consumes the previous segment's params /
+    # opt state / rng, halving peak HBM versus keeping both generations.
+    train_pop = jax.jit(
+        jax.vmap(train_segment, in_axes=(0, 0, 0, None, None, None, 0)),
+        donate_argnums=(0, 1, 6),
+    )
+    eval_pop = jax.jit(jax.vmap(eval_fold, in_axes=(0, 0, None, None, None, None)))
+    return init_pop, train_pop, eval_pop
+
+
+def _segment_bounds(total_steps: int, segment_steps) -> List[Tuple[int, int]]:
+    """Chop ``total_steps`` into bounded segments (at most 2 distinct sizes,
+    so at most 2 compiled shapes)."""
+    if not segment_steps or segment_steps >= total_steps:
+        return [(0, total_steps)]
+    seg = int(segment_steps)
+    return [(s, min(s + seg, total_steps)) for s in range(0, total_steps, seg)]
+
+
+def _run_segmented(
+    cfg: Dict[str, Any],
+    stacked,
+    params,
+    fold_keys,
+    x_np,
+    y_np,
+    val_idx,
+    val_weight,
+    batch_idx,
+    mesh,
+    batch_size: int,
+    n_train: int,
+    n_val_padded: int,
+) -> np.ndarray:
+    """Host loop over folds × bounded segments; returns (kfold, P) accs.
+
+    Every device call is short (``segment_steps`` train steps), every carry
+    (params, opt state, rng) stays device-resident, and the dataset uploads
+    once — so the only host↔device traffic per segment is one tiny index
+    array.  This is the watchdog-safe default executor; the fused
+    single-program path remains available via ``fold_parallel=True``.
+    """
+    init_pop, train_pop, eval_pop = _fold_segment_fns(
+        cfg["nodes"],
+        cfg["kernels_per_layer"],
+        cfg["dense_units"],
+        cfg["n_classes"],
+        cfg["dropout_rate"],
+        cfg["compute_dtype"],
+        cfg["epochs"],
+        cfg["learning_rate"],
+        cfg["momentum"],
+        cfg["nesterov"],
+        batch_size,
+        n_train,
+        n_val_padded,
+        bool(cfg["stage_exit_conv"]),
+    )
+    x_full, y_full = jnp.asarray(x_np), jnp.asarray(y_np)
+    masks = stacked
+    pop_s = batch_s = repl = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pop_s = NamedSharding(mesh, P("pop"))
+        batch_s = NamedSharding(mesh, P(None, "data"))
+        repl = NamedSharding(mesh, P())
+        masks = [
+            {k: jax.device_put(v, pop_s) for k, v in stage.items()} for stage in stacked
+        ]
+        x_full = jax.device_put(x_full, repl)
+        y_full = jax.device_put(y_full, repl)
+
+    kfold, total_steps = batch_idx.shape[0], batch_idx.shape[1]
+    bounds = _segment_bounds(total_steps, cfg["segment_steps"])
+    accs = []
+    for f in range(kfold):
+        p = jax.tree.map(lambda a: a[f], params)
+        rng_f = fold_keys[f]
+        if mesh is not None:
+            p = jax.device_put(p, pop_s)
+            rng_f = jax.device_put(rng_f, pop_s)
+        opt = init_pop(p)
+        for s, e in bounds:
+            seg = jnp.asarray(batch_idx[f, s:e])
+            if mesh is not None:
+                seg = jax.device_put(seg, batch_s)
+            p, opt, rng_f = train_pop(p, opt, masks, x_full, y_full, seg, rng_f)
+        vi, vw = jnp.asarray(val_idx[f]), jnp.asarray(val_weight[f])
+        if mesh is not None:
+            vi = jax.device_put(vi, repl)
+            vw = jax.device_put(vw, repl)
+        accs.append(np.asarray(eval_pop(p, masks, x_full, y_full, vi, vw), np.float32))
+        del p, opt  # this fold's buffers die before the next fold allocates
+    return np.stack(accs)
 
 
 def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed):
@@ -275,6 +436,41 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
     return jax.vmap(over_pop, in_axes=(0, None))(keys, masks_stacked)
 
 
+def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str, Any]]):
+    """Shared entry-point setup: enable the persistent compilation cache,
+    resolve the mesh, pad the population to the pop-axis size, stack genome
+    masks, and build the module.  One definition for both
+    ``cross_validate_population`` and ``train_and_score``.
+    """
+    # Persistent XLA compilation cache: a resumed/restarted search reuses
+    # the compiled program from disk (SURVEY.md §7 hard part #1).
+    cache_dir = cfg["cache_dir"] or default_cache_dir()
+    if cache_dir:
+        enable_compilation_cache(cache_dir)
+
+    # Multi-chip: shard the population axis over the mesh (and the train
+    # batch over its data axis).  Pad so the pop axis divides evenly;
+    # callers slice results back to the original length (n_real).
+    mesh = cfg["mesh"]
+    if mesh == "auto":
+        mesh = auto_mesh(pop_size=len(genomes))
+    genomes, n_real = pad_population(genomes, mesh.shape["pop"] if mesh else 1)
+    stacked = [
+        {k: jnp.asarray(v) for k, v in stage.items()}
+        for stage in stack_genome_masks(genomes, cfg["nodes"])
+    ]
+    model = MaskedGeneticCnn(
+        nodes=cfg["nodes"],
+        filters=cfg["kernels_per_layer"],
+        dense_units=cfg["dense_units"],
+        n_classes=cfg["n_classes"],
+        dropout_rate=cfg["dropout_rate"],
+        compute_dtype=jnp.dtype(cfg["compute_dtype"]),
+        stage_exit_conv=bool(cfg["stage_exit_conv"]),
+    )
+    return mesh, genomes, n_real, len(genomes), stacked, model
+
+
 class GeneticCnnModel(GentunModel):
     """Train the decoded CNN under k-fold CV; fitness = mean val accuracy.
 
@@ -290,6 +486,13 @@ class GeneticCnnModel(GentunModel):
       ``batch_size=128``; ``dense_units=500``; ``dropout_rate=0.5``;
       ``n_classes`` (inferred); ``momentum=0.9``; ``nesterov=False``;
       ``compute_dtype='bfloat16'``; ``seed=0``.
+
+    Execution knobs (rebuild-specific): ``segment_steps=96`` bounds each
+    device call in the default segmented executor (None = one call per
+    fold); ``fold_parallel=True`` switches to the fused single-program
+    vmap-folds path; ``stage_exit_conv`` adds the Xie & Yuille output-node
+    conv; ``mesh``/``cache_dir`` control sharding and the persistent
+    compilation cache.
     """
 
     def __init__(
@@ -315,6 +518,7 @@ class GeneticCnnModel(GentunModel):
         cache_dir: Optional[str] = None,
         fold_parallel: bool = False,
         stage_exit_conv: bool = False,
+        segment_steps: Optional[int] = 96,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -336,6 +540,7 @@ class GeneticCnnModel(GentunModel):
             cache_dir=cache_dir,
             fold_parallel=bool(fold_parallel),
             stage_exit_conv=bool(stage_exit_conv),
+            segment_steps=segment_steps,
         )
 
     def cross_validate(self) -> float:
@@ -364,35 +569,7 @@ class GeneticCnnModel(GentunModel):
         nodes = cfg["nodes"]
         if len(genomes) == 0:
             return np.zeros((0,), dtype=np.float32)
-
-        # Persistent XLA compilation cache: a resumed/restarted search reuses
-        # the compiled program from disk (SURVEY.md §7 hard part #1).
-        cache_dir = cfg["cache_dir"] or default_cache_dir()
-        if cache_dir:
-            enable_compilation_cache(cache_dir)
-
-        # Multi-chip: shard the population axis over the mesh (and the train
-        # batch over its data axis).  Pad so the pop axis divides evenly;
-        # results are sliced back to the caller's length.
-        mesh = cfg["mesh"]
-        if mesh == "auto":
-            mesh = auto_mesh(pop_size=len(genomes))
-        genomes, n_real = pad_population(genomes, mesh.shape["pop"] if mesh else 1)
-        pop = len(genomes)
-
-        stacked = [
-            {k: jnp.asarray(v) for k, v in stage.items()}
-            for stage in stack_genome_masks(genomes, nodes)
-        ]
-        model = MaskedGeneticCnn(
-            nodes=nodes,
-            filters=cfg["kernels_per_layer"],
-            dense_units=cfg["dense_units"],
-            n_classes=cfg["n_classes"],
-            dropout_rate=cfg["dropout_rate"],
-            compute_dtype=jnp.dtype(cfg["compute_dtype"]),
-            stage_exit_conv=bool(cfg["stage_exit_conv"]),
-        )
+        mesh, genomes, n_real, pop, stacked, model = _prepare_population_setup(cfg, genomes)
 
         kfold = cfg["kfold"]
         n = x.shape[0]
@@ -415,24 +592,6 @@ class GeneticCnnModel(GentunModel):
         total_steps = sum(cfg["epochs"]) * steps_per_epoch
         n_val_padded = int(np.ceil(fold_size / batch_size)) * batch_size
         pad = n_val_padded - fold_size
-
-        fn = _population_cv_fn(
-            nodes,
-            cfg["kernels_per_layer"],
-            cfg["dense_units"],
-            cfg["n_classes"],
-            cfg["dropout_rate"],
-            cfg["compute_dtype"],
-            cfg["epochs"],
-            cfg["learning_rate"],
-            cfg["momentum"],
-            cfg["nesterov"],
-            batch_size,
-            n_tr,
-            n_val_padded,
-            bool(cfg["fold_parallel"]),
-            bool(cfg["stage_exit_conv"]),
-        )
 
         # Per-fold index arrays (host-side numpy, tiny): the fold IS its
         # indices.  batch_idx holds *global* dataset indices, so the compiled
@@ -458,6 +617,30 @@ class GeneticCnnModel(GentunModel):
         fold_keys = jnp.stack(
             [jax.random.split(jax.random.fold_in(base_key, f), pop) for f in range(kfold)]
         )
+
+        if not cfg["fold_parallel"]:
+            accs = _run_segmented(
+                cfg, stacked, params, fold_keys, x[perm], y[perm],
+                val_idx, val_weight, batch_idx, mesh, batch_size, n_tr, n_val_padded,
+            )
+            return accs.mean(axis=0)[:n_real]
+
+        fn = _population_cv_fn(
+            nodes,
+            cfg["kernels_per_layer"],
+            cfg["dense_units"],
+            cfg["n_classes"],
+            cfg["dropout_rate"],
+            cfg["compute_dtype"],
+            cfg["epochs"],
+            cfg["learning_rate"],
+            cfg["momentum"],
+            cfg["nesterov"],
+            batch_size,
+            n_tr,
+            n_val_padded,
+            bool(cfg["stage_exit_conv"]),
+        )
         arrays = dict(
             x_full=jnp.asarray(x[perm]),
             y_full=jnp.asarray(y[perm]),
@@ -470,7 +653,7 @@ class GeneticCnnModel(GentunModel):
             params, masks, fold_keys, arrays = shard_cv_args(
                 mesh, params, stacked, fold_keys, arrays
             )
-        acc, _ = fn(
+        acc = fn(
             params,
             masks,
             arrays["x_full"],
@@ -481,6 +664,68 @@ class GeneticCnnModel(GentunModel):
             fold_keys,
         )
         return np.asarray(acc, dtype=np.float32).mean(axis=0)[:n_real]
+
+
+    # -- final holdout evaluation (not part of the reference's API) --------
+
+    @classmethod
+    def train_and_score(
+        cls,
+        x_train,
+        y_train,
+        x_test,
+        y_test,
+        genomes: Sequence[Mapping[str, Any]],
+        **config,
+    ) -> np.ndarray:
+        """Train each genome on ALL of ``x_train`` and score on a held-out
+        test set — the paper-style final number (the search itself uses
+        :meth:`cross_validate_population`).
+
+        Reuses the same compiled program family as CV: the holdout is
+        expressed as a single "fold" whose train indices cover the train
+        block and whose val indices cover the test block of one
+        device-resident concatenated array.  Returns P test accuracies.
+        Always runs the segmented executor (``fold_parallel`` is a CV-only
+        knob — with one fold there is nothing to fuse over).
+        """
+        cfg = _normalize_config(x_train, y_train, config)
+        x_tr, y_tr = _prepare_data(x_train, y_train, cfg)
+        x_te, y_te = _prepare_data(x_test, y_test, cfg)
+        if len(genomes) == 0:
+            return np.zeros((0,), dtype=np.float32)
+        mesh, genomes, n_real, pop, stacked, model = _prepare_population_setup(cfg, genomes)
+
+        n_tr, n_te = x_tr.shape[0], x_te.shape[0]
+        batch_size = min(cfg["batch_size"], n_tr)
+        steps_per_epoch = max(n_tr // batch_size, 1)
+        total_steps = sum(cfg["epochs"]) * steps_per_epoch
+        n_val_padded = int(np.ceil(n_te / batch_size)) * batch_size
+        pad = n_val_padded - n_te
+
+        rng = np.random.default_rng(cfg["seed"])
+        order = np.concatenate(
+            [rng.permutation(n_tr) for _ in range(sum(cfg["epochs"]))]
+        )[: total_steps * batch_size]
+        # Combined device-resident array: train block first, test block after.
+        batch_idx = order.astype(np.int32).reshape(1, total_steps, batch_size)
+        val_idx = (n_tr + np.concatenate([np.arange(n_te), np.zeros(pad)])).astype(np.int32)[None]
+        val_weight = np.concatenate([np.ones(n_te, np.float32), np.zeros(pad, np.float32)])[None]
+
+        params = _init_population_params(
+            model, stacked, cfg["input_shape"], pop, 1, cfg["seed"]
+        )
+        keys = jnp.stack([jax.random.split(jax.random.PRNGKey(cfg["seed"]), pop)])
+        x_full = np.concatenate([x_tr, x_te], axis=0)
+        y_full = np.concatenate([y_tr, y_te], axis=0)
+        # The holdout is one "fold"; the segmented executor drives it with
+        # the same bounded device calls as CV (full schedules stay
+        # watchdog-safe here too).
+        accs = _run_segmented(
+            cfg, stacked, params, keys, x_full, y_full,
+            val_idx, val_weight, batch_idx, mesh, batch_size, n_tr, n_val_padded,
+        )
+        return accs[0][:n_real]
 
 
 def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any]:
@@ -504,6 +749,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         cache_dir=None,
         fold_parallel=False,
         stage_exit_conv=False,
+        segment_steps=96,
     )
     unknown = set(config) - set(defaults)
     if unknown:

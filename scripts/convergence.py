@@ -1,0 +1,135 @@
+"""Full-schedule convergence run → RESULTS.md (VERDICT r1 item #10).
+
+BASELINE config #1 — Genetic CNN, MNIST stand-in (sklearn digits upscaled,
+the only offline real data on this machine), S=(3, 5), pop=10 — searched at
+the REFERENCE-DEFAULT fitness schedule: kfold=5, epochs=(20, 4, 1),
+lr=(1e-2, 1e-3, 1e-4) (SURVEY.md §3.4).  After the search, the best
+architecture is retrained on the full search split and scored on a held-out
+20% test split (`GeneticCnnModel.train_and_score`).
+
+Usage:  python scripts/convergence.py [--generations 50] [--out RESULTS.md]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gentun_tpu import GeneticAlgorithm, GeneticCnnIndividual, Population
+from gentun_tpu.models.cnn import GeneticCnnModel
+from gentun_tpu.utils.datasets import load_mnist
+
+FULL_SCHEDULE = dict(
+    nodes=(3, 5),
+    kernels_per_layer=(20, 50),
+    kfold=5,
+    epochs=(20, 4, 1),
+    learning_rate=(1e-2, 1e-3, 1e-4),
+    batch_size=128,
+    dense_units=500,
+    seed=0,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generations", type=int, default=50)
+    ap.add_argument("--population", type=int, default=10)
+    ap.add_argument("--out", default="RESULTS.md")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    x, y, meta = load_mnist()
+    rng = np.random.default_rng(args.seed)
+    perm = rng.permutation(len(x))
+    n_test = len(x) // 5
+    test_idx, search_idx = perm[:n_test], perm[n_test:]
+    x_search, y_search = x[search_idx], y[search_idx]
+    x_test, y_test = x[test_idx], y[test_idx]
+    print(f"data: {meta['source']} — search {len(x_search)}, held-out test {len(x_test)}")
+
+    pop = Population(
+        GeneticCnnIndividual,
+        x_train=x_search,
+        y_train=y_search,
+        size=args.population,
+        seed=args.seed,
+        additional_parameters=dict(FULL_SCHEDULE),
+    )
+    ga = GeneticAlgorithm(pop, seed=args.seed)
+    t0 = time.monotonic()
+    best = ga.run(args.generations)
+    search_s = time.monotonic() - t0
+
+    test_acc = float(
+        GeneticCnnModel.train_and_score(
+            x_search, y_search, x_test, y_test, [best.get_genes()], **FULL_SCHEDULE
+        )[0]
+    )
+
+    trained = sum(1 for _ in pop.fitness_cache)
+    lines = [
+        "# RESULTS — full-schedule convergence run (BASELINE config #1)",
+        "",
+        f"- Data: {meta['source']} ({len(x)} images; real handwritten digits — the",
+        "  only offline MNIST stand-in on this machine, see SURVEY.md §0).",
+        f"- Search: S=(3,5), pop={args.population}, {args.generations} generations,",
+        "  fitness = 5-fold CV mean val accuracy at the reference-default schedule",
+        "  epochs=(20,4,1), lr=(1e-2,1e-3,1e-4), batch 128 (SURVEY.md §3.4).",
+        f"- Search wall time: {search_s/60:.1f} min on {_device_desc()};",
+        f"  {trained} distinct architectures trained (fitness cache + canonical-key",
+        "  dedup answer the rest).",
+        "",
+        "## Search curve (best CV fitness per generation)",
+        "",
+        "| generation | best CV acc | evaluated (new trainings) |",
+        "|---|---|---|",
+    ]
+    for rec in ga.history:
+        lines.append(f"| {rec['generation']} | {rec['best_fitness']:.4f} | {rec['evaluated']} |")
+    lines += [
+        "",
+        "## Final result",
+        "",
+        f"- Best architecture: `{json.dumps(best.get_genes())}`",
+        f"- Best CV fitness (search metric): **{best.get_fitness():.4f}**",
+        f"- Held-out test accuracy (retrained on the full search split): **{test_acc:.4f}**",
+        "",
+        "## Context vs the paper anchor",
+        "",
+        "Xie & Yuille (ICCV 2017) report ≈99.66% on REAL MNIST (60k train images,",
+        "S=(3,5)) — BASELINE.md's accuracy anchor.  This machine has no network and",
+        "no MNIST archive, so the run uses sklearn's 1797 genuine digits upscaled",
+        "8×8→28×28: ~2.4% of MNIST's training data at one quarter the effective",
+        "resolution.  The number above is therefore an *architecture-search*",
+        "convergence artifact (the curve shows the GA improving fitness and the",
+        "held-out score confirming it generalises), not an MNIST-parity claim;",
+        "drop real MNIST into $GENTUN_TPU_DATA/mnist.npz and rerun for parity.",
+        "",
+        "## Reproduce",
+        "",
+        "```bash",
+        f"python scripts/convergence.py --generations {args.generations} "
+        f"--population {args.population} --seed {args.seed}",
+        "```",
+        "",
+    ]
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {args.out}: best CV {best.get_fitness():.4f}, test {test_acc:.4f}")
+
+
+def _device_desc() -> str:
+    import jax
+
+    d = jax.devices()[0]
+    return f"{jax.device_count()}× {d.device_kind}"
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,42 @@ def test_scale_pos_weight_trains_on_non01_labels():
     assert acc > 0.7
 
 
+def test_sklearn_gene_shadows_xgboost_twin():
+    """Mixed genomes: explicit sklearn keys win; twins are shadowed, never
+    silently merged or misreported as unmappable."""
+    from gentun_tpu.models import boosting as bm
+
+    # eta loses to learning_rate regardless of dict order
+    p = _genes_to_params({"eta": 0.3, "learning_rate": 0.1})
+    assert p["learning_rate"] == pytest.approx(0.1)
+    p = _genes_to_params({"learning_rate": 0.1, "eta": 0.3})
+    assert p["learning_rate"] == pytest.approx(0.1)
+    # explicit max_features beats the colsample product
+    p = _genes_to_params({"max_features": 0.9, "colsample_bytree": 0.5, "colsample_bylevel": 0.5})
+    assert p["max_features"] == pytest.approx(0.9)
+    # and the shadowed twins are reported as SHADOWED, not "no equivalent"
+    import logging
+
+    bm._inert_warned.clear()
+
+    class Cap(logging.Handler):
+        msgs = []
+
+        def emit(self, r):
+            Cap.msgs.append(r.getMessage())
+
+    h = Cap()
+    logging.getLogger("gentun_tpu").addHandler(h)
+    try:
+        _genes_to_params({"max_features": 0.9, "colsample_bytree": 0.5, "eta": 0.3,
+                          "learning_rate": 0.1})
+    finally:
+        logging.getLogger("gentun_tpu").removeHandler(h)
+    joined = " ".join(Cap.msgs)
+    assert "SHADOWED" in joined and "colsample_bytree" in joined and "eta" in joined
+    assert "INERT" not in joined  # nothing here is unmappable
+
+
 def test_inert_genes_warn_loudly(caplog):
     """No silently-inert genes: translation states effective dimensionality."""
     import logging
@@ -93,7 +129,7 @@ def test_inert_genes_warn_loudly(caplog):
 
 def test_full_xgboost_genome_trains(tabular_data):
     """A reference-shaped 11-gene genome runs end-to-end on the sklearn
-    backend with 8 of 11 genes live."""
+    backend with 7 of 11 genes live (alpha is inert when lambda competes)."""
     x, y = tabular_data
     genes = xgboost_genome().default()
     acc = BoostingModel(x, y, genes, kfold=3, seed=0).cross_validate()

@@ -11,7 +11,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from gentun_tpu.models.cnn import GeneticCnnModel, MaskedGeneticCnn, _population_cv_fn
+from gentun_tpu.models.cnn import GeneticCnnModel, MaskedGeneticCnn
 from gentun_tpu.ops.dag import stack_genome_masks
 
 FAST = dict(
@@ -147,11 +147,15 @@ class TestGeneticCnnModelCV:
         assert 0.5 < m.cross_validate() <= 1.0
 
     def test_compile_cache_no_retrace_across_calls(self, separable_data):
+        from gentun_tpu.models.cnn import _fold_segment_fns
+
         x, y = separable_data
-        before = _population_cv_fn.cache_info().hits
+        GeneticCnnModel.cross_validate_population(x, y, [{"S_1": (0, 1, 0)}], **FAST)
+        before = _fold_segment_fns.cache_info().hits
         GeneticCnnModel.cross_validate_population(x, y, [{"S_1": (1, 1, 0)}], **FAST)
-        after = _population_cv_fn.cache_info()
-        # The earlier tests used identical static config: the factory must hit.
+        after = _fold_segment_fns.cache_info()
+        # Identical static config: the segmented-factory must hit its cache
+        # (same jitted program family for every genome — SURVEY.md §7 #1).
         assert after.hits > before
 
     def test_config_validation(self, separable_data):
@@ -207,3 +211,54 @@ class TestStageExitConv:
         assert accs.shape == (2,)
         assert np.isfinite(accs).all()
         assert (accs > 0.25).all()  # beats 4-class chance
+
+
+class TestTrainAndScore:
+    def test_holdout_scores_match_separability(self, separable_data):
+        x, y = separable_data
+        x_tr, y_tr, x_te, y_te = x[:160], y[:160], x[160:], y[160:]
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (1, 1, 1)}]
+        accs = GeneticCnnModel.train_and_score(
+            x_tr, y_tr, x_te, y_te, genomes, **FAST
+        )
+        assert accs.shape == (2,)
+        assert np.isfinite(accs).all()
+        assert (accs > 0.25).all()  # beats 4-class chance on held-out data
+
+    def test_holdout_single_genome_and_uneven_test(self, separable_data):
+        x, y = separable_data
+        # test block not divisible by batch_size: exercises padding weights
+        accs = GeneticCnnModel.train_and_score(
+            x[:150], y[:150], x[150:183], y[150:183], [{"S_1": (1, 0, 1)}], **FAST
+        )
+        assert accs.shape == (1,)
+        assert 0.0 <= float(accs[0]) <= 1.0
+
+
+class TestSegmentedExecution:
+    """Default executor: host loop of bounded device calls (watchdog-safe)."""
+
+    def test_segmented_matches_fused_exactly(self, separable_data):
+        """Same schedule, same seeds: segmented (any segment size) and the
+        fused single-program path must produce identical accuracies."""
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (1, 1, 1)}]
+        fused = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, **{**FAST, "fold_parallel": True}
+        )
+        seg_big = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, **{**FAST, "segment_steps": None}
+        )
+        seg_tiny = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, **{**FAST, "segment_steps": 2}
+        )
+        np.testing.assert_allclose(seg_big, seg_tiny, atol=1e-5)
+        np.testing.assert_allclose(fused, seg_big, atol=1e-4)
+
+    def test_segment_bounds(self):
+        from gentun_tpu.models.cnn import _segment_bounds
+
+        assert _segment_bounds(10, None) == [(0, 10)]
+        assert _segment_bounds(10, 96) == [(0, 10)]
+        assert _segment_bounds(10, 4) == [(0, 4), (4, 8), (8, 10)]
+        assert _segment_bounds(8, 4) == [(0, 4), (4, 8)]
