@@ -117,11 +117,9 @@ def schedule_flops(cfg: dict, pop: int) -> float:
     return pop * kfold * (train + evalf)
 
 
-def timed_run(x, y, cfg: dict, pop: int, warmup: bool):
+def timed_run(x, y, cfg: dict, pop: int):
     from gentun_tpu.models.cnn import GeneticCnnModel
 
-    if warmup:  # compile + cache the one program for these shapes
-        GeneticCnnModel.cross_validate_population(x, y, random_population(pop, seed=1), **cfg)
     t0 = time.monotonic()
     accs = GeneticCnnModel.cross_validate_population(x, y, random_population(pop, seed=2), **cfg)
     return np.asarray(accs), time.monotonic() - t0
@@ -134,7 +132,15 @@ def main() -> None:
     n_chips = jax.local_device_count()
 
     # -- primary metric: proxy-schedule steady-state throughput ------------
-    proxy_accs, proxy_s = timed_run(x, y, PROXY, POP, warmup=True)
+    # Median of 3 measured repetitions: the tunneled chip shows ±20%
+    # run-to-run wall-clock variance, and the medium is what a search
+    # actually sustains.
+    timed_run(x, y, PROXY, POP)  # compile/cache warmup run
+    reps = []
+    for _ in range(3):
+        proxy_accs, proxy_s = timed_run(x, y, PROXY, POP)
+        reps.append(proxy_s)
+    proxy_s = float(np.median(reps))
     value = POP / proxy_s * 3600.0 / n_chips
     assert np.isfinite(proxy_accs).all()
     chance = 1.0 / N_CLASSES
@@ -153,28 +159,34 @@ def main() -> None:
     }
 
     # -- full reference-default schedule + MFU (VERDICT r1 #2) -------------
+    # The full run is 62.5× the proxy budget; a crash or failed assertion
+    # there must not discard the already-measured primary metric, so it is
+    # recorded as an error field on the same single JSON line instead.
     if os.environ.get("GENTUN_BENCH_FULL", "1") != "0":
-        # One run, compile included: at 62.5× the proxy budget the compile
-        # is noise, and a search would pay it once per 1000 evaluations.
-        full_accs, full_s = timed_run(x, y, FULL, POP, warmup=False)
-        full_rate = POP / full_s * 3600.0 / n_chips
-        mfu = schedule_flops(FULL, POP) / full_s / (PEAK_FLOPS * n_chips)
-        assert np.isfinite(full_accs).all()
-        assert full_accs.mean() > 4 * chance, (
-            f"full-schedule accuracy {full_accs.mean():.3f} does not beat 4x chance"
-        )
-        record["full_schedule"] = {
-            "individuals_per_hour_per_chip": round(full_rate, 2),
-            "vs_baseline": round(full_rate / BASELINE_INDIVIDUALS_PER_HOUR_PER_CHIP, 3),
-            "wall_s": round(full_s, 1),
-            "schedule": "kfold=5 epochs=(20,4,1) lr=(1e-2,1e-3,1e-4)",
-            "accuracy_mean": round(float(full_accs.mean()), 4),
-        }
-        record["mfu"] = {
-            "value": round(mfu, 4),
-            "basis": "analytic conv+dense MACs (lower bound), full schedule",
-            "peak_flops_per_chip": PEAK_FLOPS,
-        }
+        try:
+            # One run, compile included: at this budget the compile is
+            # noise, and a search pays it once per 1000 evaluations.
+            full_accs, full_s = timed_run(x, y, FULL, POP)
+            full_rate = POP / full_s * 3600.0 / n_chips
+            mfu = schedule_flops(FULL, POP) / full_s / (PEAK_FLOPS * n_chips)
+            assert np.isfinite(full_accs).all()
+            assert full_accs.mean() > 4 * chance, (
+                f"full-schedule accuracy {full_accs.mean():.3f} does not beat 4x chance"
+            )
+            record["full_schedule"] = {
+                "individuals_per_hour_per_chip": round(full_rate, 2),
+                "vs_baseline": round(full_rate / BASELINE_INDIVIDUALS_PER_HOUR_PER_CHIP, 3),
+                "wall_s": round(full_s, 1),
+                "schedule": "kfold=5 epochs=(20,4,1) lr=(1e-2,1e-3,1e-4)",
+                "accuracy_mean": round(float(full_accs.mean()), 4),
+            }
+            record["mfu"] = {
+                "value": round(mfu, 4),
+                "basis": "analytic conv+dense MACs (lower bound), full schedule",
+                "peak_flops_per_chip": PEAK_FLOPS,
+            }
+        except Exception as e:  # loud but non-fatal: the proxy metric survives
+            record["full_schedule"] = {"error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps(record))
 

@@ -1,0 +1,100 @@
+"""Worker launcher: ``python -m gentun_tpu.distributed.worker``.
+
+The reference starts workers as hand-written scripts wrapping
+``GentunClient`` (gentun examples [PUB]; SURVEY.md §3.3).  This module is
+the installable equivalent — point it at the master and a local dataset and
+it consumes jobs until killed:
+
+    python -m gentun_tpu.distributed.worker \
+        --host <master-ip> --port 5672 --password s3cret \
+        --species genetic-cnn --dataset mnist --capacity 8
+
+All model hyperparameters (``additional_parameters``) arrive from the
+master with each job, so the worker needs only its species and its copy of
+the training data — genes in, fitness out (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def _load_dataset(name: str, data_dir=None, n=None):
+    from ..utils import datasets as ds
+
+    loaders = {
+        "mnist": lambda: ds.load_mnist(n=n, data_dir=data_dir),
+        "cifar10": lambda: ds.load_cifar10(**({"n": n} if n else {}), data_dir=data_dir),
+        "cifar100": lambda: ds.load_cifar100(**({"n": n} if n else {}), data_dir=data_dir),
+        "uci-wine": lambda: ds.load_uci_wine(),
+        "uci-binary": lambda: ds.load_uci_binary(),
+    }
+    if name not in loaders:
+        raise SystemExit(f"unknown dataset {name!r}; choose from {sorted(loaders)}")
+    if name.startswith("uci-") and (data_dir is not None or n is not None):
+        # The UCI tables are fixed sklearn datasets with no npz override or
+        # subsampling path — don't let the flags silently no-op.
+        raise SystemExit(f"--n/--data-dir are not supported for dataset {name!r}")
+    return loaders[name]()
+
+
+def _species(name: str):
+    from ..individuals import BoostingIndividual, GeneticCnnIndividual
+
+    table = {"genetic-cnn": GeneticCnnIndividual, "boosting": BoostingIndividual}
+    if name not in table:
+        raise SystemExit(f"unknown species {name!r}; choose from {sorted(table)}")
+    return table[name]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gentun_tpu.distributed.worker",
+        description="gentun_tpu fitness worker (owns the data, trains shipped genes)",
+    )
+    ap.add_argument("--host", default="127.0.0.1", help="master broker host")
+    ap.add_argument("--port", type=int, default=5672, help="master broker port")
+    ap.add_argument("--password", default=None, help="broker shared token")
+    ap.add_argument("--species", default="genetic-cnn", help="genetic-cnn | boosting")
+    ap.add_argument("--dataset", default="mnist",
+                    help="mnist | cifar10 | cifar100 | uci-wine | uci-binary")
+    ap.add_argument("--data-dir", default=None,
+                    help="directory with {name}.npz overrides (or $GENTUN_TPU_DATA)")
+    ap.add_argument("--n", type=int, default=None, help="subsample the dataset to n examples")
+    ap.add_argument("--capacity", type=int, default=1,
+                    help="jobs taken at once; >1 trains the batch as one vmapped program")
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--max-jobs", type=int, default=None, help="exit after this many results")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    x, y, meta = _load_dataset(args.dataset, data_dir=args.data_dir, n=args.n)
+    logging.getLogger("gentun_tpu.distributed").info(
+        "worker data: %s (%d examples, synthetic=%s)", meta.get("source", args.dataset),
+        len(x), meta.get("synthetic"),
+    )
+
+    from .client import GentunClient
+
+    client = GentunClient(
+        _species(args.species),
+        x,
+        y,
+        host=args.host,
+        port=args.port,
+        password=args.password,
+        capacity=args.capacity,
+        worker_id=args.worker_id,
+    )
+    done = client.work(max_jobs=args.max_jobs)
+    logging.getLogger("gentun_tpu.distributed").info("worker exiting after %d job(s)", done)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -331,6 +331,32 @@ def _fold_segment_fns(
     return init_pop, train_pop, eval_pop
 
 
+def _static_key(cfg: Dict[str, Any], batch_size: int, n_train: int, n_val_padded: int) -> Tuple:
+    """The ONE definition of the compiled-program static key.
+
+    Both lru-cached factories (:func:`_population_cv_fn`,
+    :func:`_fold_segment_fns`) key on exactly this tuple — a new config knob
+    added here reaches every cache key at once, so the executors can never
+    silently share a program compiled for a different config.
+    """
+    return (
+        cfg["nodes"],
+        cfg["kernels_per_layer"],
+        cfg["dense_units"],
+        cfg["n_classes"],
+        cfg["dropout_rate"],
+        cfg["compute_dtype"],
+        cfg["epochs"],
+        cfg["learning_rate"],
+        cfg["momentum"],
+        cfg["nesterov"],
+        batch_size,
+        n_train,
+        n_val_padded,
+        bool(cfg["stage_exit_conv"]),
+    )
+
+
 def _segment_bounds(total_steps: int, segment_steps) -> List[Tuple[int, int]]:
     """Chop ``total_steps`` into bounded segments (at most 2 distinct sizes,
     so at most 2 compiled shapes)."""
@@ -364,20 +390,7 @@ def _run_segmented(
     single-program path remains available via ``fold_parallel=True``.
     """
     init_pop, train_pop, eval_pop = _fold_segment_fns(
-        cfg["nodes"],
-        cfg["kernels_per_layer"],
-        cfg["dense_units"],
-        cfg["n_classes"],
-        cfg["dropout_rate"],
-        cfg["compute_dtype"],
-        cfg["epochs"],
-        cfg["learning_rate"],
-        cfg["momentum"],
-        cfg["nesterov"],
-        batch_size,
-        n_train,
-        n_val_padded,
-        bool(cfg["stage_exit_conv"]),
+        *_static_key(cfg, batch_size, n_train, n_val_padded)
     )
     x_full, y_full = jnp.asarray(x_np), jnp.asarray(y_np)
     masks = stacked
@@ -625,22 +638,7 @@ class GeneticCnnModel(GentunModel):
             )
             return accs.mean(axis=0)[:n_real]
 
-        fn = _population_cv_fn(
-            nodes,
-            cfg["kernels_per_layer"],
-            cfg["dense_units"],
-            cfg["n_classes"],
-            cfg["dropout_rate"],
-            cfg["compute_dtype"],
-            cfg["epochs"],
-            cfg["learning_rate"],
-            cfg["momentum"],
-            cfg["nesterov"],
-            batch_size,
-            n_tr,
-            n_val_padded,
-            bool(cfg["stage_exit_conv"]),
-        )
+        fn = _population_cv_fn(*_static_key(cfg, batch_size, n_tr, n_val_padded))
         arrays = dict(
             x_full=jnp.asarray(x[perm]),
             y_full=jnp.asarray(y[perm]),
@@ -763,6 +761,10 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
     cfg["learning_rate"] = tuple(float(r) for r in cfg["learning_rate"])
     if len(cfg["epochs"]) != len(cfg["learning_rate"]):
         raise ValueError("epochs and learning_rate must be parallel tuples")
+    if cfg["segment_steps"] is not None:
+        cfg["segment_steps"] = int(cfg["segment_steps"])
+        if cfg["segment_steps"] < 1:
+            raise ValueError("segment_steps must be a positive int or None")
     x = np.asarray(x_train)
     if cfg["input_shape"] is None:
         if x.ndim == 4:

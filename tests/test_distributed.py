@@ -477,6 +477,111 @@ class TestMasterSideDedup:
                 stop.set()
 
 
+class TestBrokerEdgeCases:
+    def test_gather_timeout_applies_partial_results(self):
+        """A straggler timeout keeps the fitnesses that DID arrive."""
+        with DistributedPopulation(
+            SlowOneMax, size=3, seed=8, port=0, job_timeout=2.5,
+            additional_parameters={"delay": 0.2},
+        ) as pop:
+            _, port = pop.broker_address
+            # One worker, capacity 1, allowed to finish exactly TWO jobs,
+            # then it exits — the third job can never finish.
+            t = threading.Thread(
+                target=_run_worker,
+                args=(SlowOneMax, port),
+                kwargs={"max_jobs": 2},
+                daemon=True,
+            )
+            t.start()
+            with pytest.raises(TimeoutError):
+                pop.evaluate()
+            evaluated = [ind for ind in pop if ind.fitness_evaluated]
+            assert len(evaluated) == 2  # finished work survived the timeout
+            # retry reships ONLY the unfinished individual
+            stop, _ = _start_worker_thread(SlowOneMax, port)
+            try:
+                assert pop.evaluate() == 1
+                assert all(ind.fitness_evaluated for ind in pop)
+            finally:
+                stop.set()
+
+    def test_oversized_payload_raises_in_submit(self):
+        """Size validation happens in the caller's thread, not the loop."""
+        from gentun_tpu.distributed.protocol import MAX_MESSAGE_BYTES, ProtocolError
+
+        broker = JobBroker(port=0).start()
+        try:
+            huge = {"genes": {"S_1": "x" * (MAX_MESSAGE_BYTES + 10)}, "additional_parameters": {}}
+            with pytest.raises(ProtocolError):
+                broker.submit({"j": huge})
+            assert broker._payloads == {}  # nothing was enqueued
+        finally:
+            broker.stop()
+
+    def test_large_batch_splits_into_multiple_frames_and_completes(self):
+        """Batches over the soft cap arrive as several `jobs` frames; a real
+        worker consumes them frame by frame and every job completes."""
+        from gentun_tpu.distributed.protocol import MAX_MESSAGE_BYTES
+
+        # ~1.3 MB of padding per job => 4 jobs exceed the 2 MB soft cap.
+        pad = "p" * (MAX_MESSAGE_BYTES // 3)
+        inds = [
+            OneMax(genes={"S_1": (1, 0, i % 2, 0, 1, 0), "S_2": (1,) * 6},
+                   additional_parameters={"nodes": (4, 4), "pad": pad})
+            for i in range(4)
+        ]
+        with DistributedPopulation(
+            OneMax,
+            individual_list=inds,
+            additional_parameters={"nodes": (4, 4), "pad": pad},
+            port=0,
+            job_timeout=30.0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(OneMax, port, capacity=4)
+            try:
+                pop.evaluate()
+                assert all(ind.fitness_evaluated for ind in pop)
+            finally:
+                stop.set()
+
+
+class TestWorkerCli:
+    def test_module_entrypoint_serves_jobs(self):
+        """`python -m gentun_tpu.distributed.worker` is a functioning worker:
+        it loads its own dataset, serves the master's jobs, and exits at
+        --max-jobs."""
+        import subprocess
+        import sys
+
+        from gentun_tpu import BoostingIndividual
+
+        with DistributedPopulation(
+            BoostingIndividual, size=2, seed=9, port=0,
+            additional_parameters={"kfold": 2},
+            job_timeout=120.0,
+        ) as pop:
+            _, port = pop.broker_address
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            env = dict(os.environ, PYTHONPATH=repo)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "gentun_tpu.distributed.worker",
+                 "--host", "127.0.0.1", "--port", str(port),
+                 "--species", "boosting", "--dataset", "uci-binary",
+                 "--max-jobs", "2"],
+                env=env, cwd=repo,
+            )
+            try:
+                pop.evaluate()
+                assert all(ind.fitness_evaluated for ind in pop)
+                assert all(0.0 <= ind.get_fitness() <= 1.0 for ind in pop)
+                assert proc.wait(timeout=30) == 0  # exited cleanly at --max-jobs
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+
+
 class TestMasterCrashResume:
     """SURVEY.md §5: 'Master death is unrecoverable' in the reference — the
     rebuild beats it: checkpoint + DistributedPopulation survive a master
