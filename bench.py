@@ -133,7 +133,7 @@ def main() -> None:
 
     # -- primary metric: proxy-schedule steady-state throughput ------------
     # Median of 3 measured repetitions: the tunneled chip shows ±20%
-    # run-to-run wall-clock variance, and the medium is what a search
+    # run-to-run wall-clock variance, and the median is what a search
     # actually sustains.
     timed_run(x, y, PROXY, POP)  # compile/cache warmup run
     reps = []

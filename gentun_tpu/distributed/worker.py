@@ -21,22 +21,33 @@ import logging
 
 
 def _load_dataset(name: str, data_dir=None, n=None):
+    import numpy as np
+
     from ..utils import datasets as ds
 
     loaders = {
-        "mnist": lambda: ds.load_mnist(n=n, data_dir=data_dir),
-        "cifar10": lambda: ds.load_cifar10(**({"n": n} if n else {}), data_dir=data_dir),
-        "cifar100": lambda: ds.load_cifar100(**({"n": n} if n else {}), data_dir=data_dir),
+        "mnist": lambda: ds.load_mnist(data_dir=data_dir),
+        "cifar10": lambda: ds.load_cifar10(data_dir=data_dir),
+        "cifar100": lambda: ds.load_cifar100(data_dir=data_dir),
         "uci-wine": lambda: ds.load_uci_wine(),
         "uci-binary": lambda: ds.load_uci_binary(),
     }
     if name not in loaders:
         raise SystemExit(f"unknown dataset {name!r}; choose from {sorted(loaders)}")
-    if name.startswith("uci-") and (data_dir is not None or n is not None):
-        # The UCI tables are fixed sklearn datasets with no npz override or
-        # subsampling path — don't let the flags silently no-op.
-        raise SystemExit(f"--n/--data-dir are not supported for dataset {name!r}")
-    return loaders[name]()
+    if name.startswith("uci-") and data_dir is not None:
+        # The UCI tables are fixed sklearn datasets with no npz override —
+        # don't let the flag silently no-op.
+        raise SystemExit(f"--data-dir is not supported for dataset {name!r}")
+    x, y, meta = loaders[name]()
+    if n is not None:
+        # Subsample HERE, uniformly for every dataset (some loaders apply
+        # `n` only on some code paths — doing it post-load removes the
+        # inconsistency and makes --n 0 / --n > len(x) loud errors).
+        if not 0 < n <= len(x):
+            raise SystemExit(f"--n {n} out of range for {name!r} ({len(x)} examples)")
+        idx = np.random.default_rng(0).permutation(len(x))[:n]
+        x, y = x[idx], y[idx]
+    return x, y, meta
 
 
 def _species(name: str):

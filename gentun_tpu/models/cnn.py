@@ -426,9 +426,14 @@ def _run_segmented(
         if mesh is not None:
             vi = jax.device_put(vi, repl)
             vw = jax.device_put(vw, repl)
-        accs.append(np.asarray(eval_pop(p, masks, x_full, y_full, vi, vw), np.float32))
-        del p, opt  # this fold's buffers die before the next fold allocates
-    return np.stack(accs)
+        # Keep the result ON device: materialising here would block the host
+        # until fold f finishes and leave the device idle while the host
+        # prepares fold f+1.  jax dispatch is async, so appending the device
+        # array keeps the execution queue full across folds; params/opt
+        # buffers still die at loop end (acc is tiny).
+        accs.append(eval_pop(p, masks, x_full, y_full, vi, vw))
+        del p, opt
+    return np.stack([np.asarray(a, np.float32) for a in accs])
 
 
 def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed):
@@ -579,7 +584,6 @@ class GeneticCnnModel(GentunModel):
         """
         cfg = _normalize_config(x_train, y_train, config)
         x, y = _prepare_data(x_train, y_train, cfg)
-        nodes = cfg["nodes"]
         if len(genomes) == 0:
             return np.zeros((0,), dtype=np.float32)
         mesh, genomes, n_real, pop, stacked, model = _prepare_population_setup(cfg, genomes)
