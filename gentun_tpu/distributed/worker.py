@@ -25,10 +25,13 @@ def _load_dataset(name: str, data_dir=None, n=None):
 
     from ..utils import datasets as ds
 
+    # `n` forwards to the loaders that accept it (so npz archives larger
+    # than the loader default stay reachable)...
+    n_kw = {"n": n} if n is not None else {}
     loaders = {
-        "mnist": lambda: ds.load_mnist(data_dir=data_dir),
-        "cifar10": lambda: ds.load_cifar10(data_dir=data_dir),
-        "cifar100": lambda: ds.load_cifar100(data_dir=data_dir),
+        "mnist": lambda: ds.load_mnist(**n_kw, data_dir=data_dir),
+        "cifar10": lambda: ds.load_cifar10(**n_kw, data_dir=data_dir),
+        "cifar100": lambda: ds.load_cifar100(**n_kw, data_dir=data_dir),
         "uci-wine": lambda: ds.load_uci_wine(),
         "uci-binary": lambda: ds.load_uci_binary(),
     }
@@ -40,13 +43,14 @@ def _load_dataset(name: str, data_dir=None, n=None):
         raise SystemExit(f"--data-dir is not supported for dataset {name!r}")
     x, y, meta = loaders[name]()
     if n is not None:
-        # Subsample HERE, uniformly for every dataset (some loaders apply
-        # `n` only on some code paths — doing it post-load removes the
-        # inconsistency and makes --n 0 / --n > len(x) loud errors).
-        if not 0 < n <= len(x):
-            raise SystemExit(f"--n {n} out of range for {name!r} ({len(x)} examples)")
-        idx = np.random.default_rng(0).permutation(len(x))[:n]
-        x, y = x[idx], y[idx]
+        # ...and is then ENFORCED here, uniformly: loaders apply `n` only on
+        # some code paths (e.g. not on npz overrides), so silently-ignored
+        # or unsatisfiable values become loud errors instead.
+        if n <= 0 or len(x) < n:
+            raise SystemExit(f"--n {n} not satisfiable for {name!r} ({len(x)} examples available)")
+        if len(x) > n:
+            idx = np.random.default_rng(0).permutation(len(x))[:n]
+            x, y = x[idx], y[idx]
     return x, y, meta
 
 

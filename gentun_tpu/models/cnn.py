@@ -454,11 +454,32 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
     return jax.vmap(over_pop, in_axes=(0, None))(keys, masks_stacked)
 
 
+def _pop_bucket(n: int) -> int:
+    """Round SMALL population batches up to a power of two (≤ 16).
+
+    The population axis is a compile-time shape: a GA's later generations
+    evaluate whatever the fitness cache didn't answer — small, varying
+    batches (5, 2, 1, ...) — and each distinct size would otherwise pay a
+    full XLA compile (minutes for CIFAR-scale configs).  Bucketing bounds a
+    search to at most {1, 2, 4, 8, 16} small shapes plus the full-population
+    shape; waste is < 2× and only where the absolute cost is small.  Batches
+    ≥ 16 stay exact — they are the dominant cost and occur at one stable
+    size (the full population).
+    """
+    if n >= 16:
+        return n
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str, Any]]):
     """Shared entry-point setup: enable the persistent compilation cache,
-    resolve the mesh, pad the population to the pop-axis size, stack genome
-    masks, and build the module.  One definition for both
-    ``cross_validate_population`` and ``train_and_score``.
+    resolve the mesh, pad the population to the compile-shape bucket and
+    the pop-axis size, stack genome masks, and build the module.  One
+    definition for both ``cross_validate_population`` and
+    ``train_and_score``.
     """
     # Persistent XLA compilation cache: a resumed/restarted search reuses
     # the compiled program from disk (SURVEY.md §7 hard part #1).
@@ -472,7 +493,17 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     mesh = cfg["mesh"]
     if mesh == "auto":
         mesh = auto_mesh(pop_size=len(genomes))
-    genomes, n_real = pad_population(genomes, mesh.shape["pop"] if mesh else 1)
+    multiple = mesh.shape["pop"] if mesh else 1
+    if cfg["pop_padding"]:
+        target = _pop_bucket(len(genomes))
+        # honor the mesh multiple on top of the bucket
+        if target % multiple:
+            target += multiple - target % multiple
+        # len(genomes) <= target < 2*target, so padding to a multiple of
+        # `target` is padding to exactly `target`.
+        genomes, n_real = pad_population(genomes, target)
+    else:
+        genomes, n_real = pad_population(genomes, multiple)
     stacked = [
         {k: jnp.asarray(v) for k, v in stage.items()}
         for stage in stack_genome_masks(genomes, cfg["nodes"])
@@ -537,6 +568,7 @@ class GeneticCnnModel(GentunModel):
         fold_parallel: bool = False,
         stage_exit_conv: bool = False,
         segment_steps: Optional[int] = 96,
+        pop_padding: bool = True,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -559,6 +591,7 @@ class GeneticCnnModel(GentunModel):
             fold_parallel=bool(fold_parallel),
             stage_exit_conv=bool(stage_exit_conv),
             segment_steps=segment_steps,
+            pop_padding=bool(pop_padding),
         )
 
     def cross_validate(self) -> float:
@@ -752,6 +785,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         fold_parallel=False,
         stage_exit_conv=False,
         segment_steps=96,
+        pop_padding=True,
     )
     unknown = set(config) - set(defaults)
     if unknown:

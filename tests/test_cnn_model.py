@@ -262,3 +262,33 @@ class TestSegmentedExecution:
         assert _segment_bounds(10, 96) == [(0, 10)]
         assert _segment_bounds(10, 4) == [(0, 4), (4, 8), (8, 10)]
         assert _segment_bounds(8, 4) == [(0, 4), (4, 8)]
+
+
+class TestPopBucketing:
+    def test_bucket_function(self):
+        from gentun_tpu.models.cnn import _pop_bucket
+
+        assert [_pop_bucket(n) for n in (1, 2, 3, 5, 8, 9, 15)] == [1, 2, 4, 8, 8, 16, 16]
+        assert _pop_bucket(16) == 16 and _pop_bucket(20) == 20  # large = exact
+
+    def test_small_batches_share_compiled_shape(self, separable_data):
+        """Sizes 3 and 4 pad to the same bucket (4): the segmented factory's
+        jitted fns see one shape, so the second call cannot retrace."""
+        x, y = separable_data
+        g = lambda bits: {"S_1": bits}
+        a3 = GeneticCnnModel.cross_validate_population(
+            x, y, [g((1, 0, 1)), g((0, 1, 0)), g((1, 1, 0))], **FAST
+        )
+        a4 = GeneticCnnModel.cross_validate_population(
+            x, y, [g((1, 0, 1)), g((0, 1, 0)), g((1, 1, 0)), g((1, 1, 1))], **FAST
+        )
+        assert a3.shape == (3,) and a4.shape == (4,)
+        # padding is invisible: shared genomes score identically across calls
+        np.testing.assert_allclose(a3, a4[:3], atol=1e-5)
+
+    def test_padding_disabled_keeps_exact_size(self, separable_data):
+        x, y = separable_data
+        accs = GeneticCnnModel.cross_validate_population(
+            x, y, [{"S_1": (1, 0, 1)}] * 3, **{**FAST, "pop_padding": False}
+        )
+        assert accs.shape == (3,)
