@@ -25,6 +25,10 @@ def _load_dataset(name: str, data_dir=None, n=None):
 
     from ..utils import datasets as ds
 
+    if n is not None and n <= 0:
+        # Validate BEFORE the loaders see n: a negative value would raise a
+        # raw numpy error (or a huge one allocate) inside the loader.
+        raise SystemExit(f"--n must be positive, got {n}")
     # `n` forwards to the loaders that accept it (so npz archives larger
     # than the loader default stay reachable)...
     n_kw = {"n": n} if n is not None else {}

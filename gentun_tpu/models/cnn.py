@@ -436,6 +436,25 @@ def _run_segmented(
     return np.stack([np.asarray(a, np.float32) for a in accs])
 
 
+@functools.lru_cache(maxsize=32)
+def _init_fn(model: MaskedGeneticCnn, input_shape: Tuple[int, ...]):
+    """Jitted (fold × pop)-vmapped parameter init for one module config.
+
+    ``model.init`` runs a full forward pass; unjitted it dispatches op by op
+    (3+ seconds per generation on a tunneled chip, ~30% of a proxy-schedule
+    evaluation).  The jitted callable is cached per (module, input_shape) —
+    flax modules are frozen dataclasses, so they hash by config — and jax
+    re-specialises it per (kfold, pop) shape automatically.
+    """
+    dummy = jnp.zeros((1, *input_shape), dtype=jnp.float32)
+
+    def init_one(key, masks):
+        return model.init({"params": key}, dummy, masks, train=False)["params"]
+
+    over_pop = jax.vmap(init_one, in_axes=(0, 0))
+    return jax.jit(jax.vmap(over_pop, in_axes=(0, None)))
+
+
 def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed):
     """Per-(fold, individual) parameter init → shapes carry a (kfold, P) prefix.
 
@@ -445,13 +464,7 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
     keys = jnp.stack(
         [jax.random.split(jax.random.PRNGKey(seed + f), pop_size) for f in range(kfold)]
     )
-    dummy = jnp.zeros((1, *input_shape), dtype=jnp.float32)
-
-    def init_one(key, masks):
-        return model.init({"params": key}, dummy, masks, train=False)["params"]
-
-    over_pop = jax.vmap(init_one, in_axes=(0, 0))
-    return jax.vmap(over_pop, in_axes=(0, None))(keys, masks_stacked)
+    return _init_fn(model, tuple(input_shape))(keys, masks_stacked)
 
 
 def _pop_bucket(n: int) -> int:
@@ -490,12 +503,15 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     # Multi-chip: shard the population axis over the mesh (and the train
     # batch over its data axis).  Pad so the pop axis divides evenly;
     # callers slice results back to the original length (n_real).
+    # The mesh derives from the BUCKETED size: deriving it from the raw
+    # size would give different small batches different mesh factorings
+    # (and therefore fresh compiles) even though they pad to one shape.
+    target = _pop_bucket(len(genomes)) if cfg["pop_padding"] else len(genomes)
     mesh = cfg["mesh"]
     if mesh == "auto":
-        mesh = auto_mesh(pop_size=len(genomes))
+        mesh = auto_mesh(pop_size=target)
     multiple = mesh.shape["pop"] if mesh else 1
     if cfg["pop_padding"]:
-        target = _pop_bucket(len(genomes))
         # honor the mesh multiple on top of the bucket
         if target % multiple:
             target += multiple - target % multiple
