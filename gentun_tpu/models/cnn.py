@@ -49,6 +49,7 @@ TPU-first architecture (NOT how the reference does it — SURVEY.md §7
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -467,6 +468,50 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
     return _init_fn(model, tuple(input_shape))(keys, masks_stacked)
 
 
+#: (id(x_key), id(y_key), seed, n_use, input_shape) →
+#: (weakref(x_key), weakref(y_key), x_dev, y_dev).  Kept tiny (a handful of
+#: datasets); entries are validated by object identity through the
+#: weakrefs, so a recycled id can never alias.
+_DATASET_CACHE: Dict[Tuple, Tuple[Any, Any, Any, Any]] = {}
+
+
+def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarray, cfg: Dict[str, Any]):
+    """Device-resident permuted dataset, cached across evaluate() calls.
+
+    Uploading the dataset dominates a warm proxy evaluation on a tunneled
+    chip (~4.3s of 7.4s measured for CIFAR-10-sized data) and a GA pays it
+    every generation even though the dataset never changes within a search.
+
+    The cache is keyed by the identity of the CALLER's arrays (``key_x`` /
+    ``key_y`` — the objects a Population holds stable across generations),
+    never by the ``_prepare_data`` outputs, which are fresh objects on every
+    call whenever a reshape/dtype conversion happens.  The converted content
+    is a pure function of (caller array, input_shape), and the permutation
+    of (seed, n), so key identity + the cfg fields fully determine the
+    device content.  Like everything jax, this assumes arrays are not
+    mutated in place.
+    """
+    # Evict dead entries eagerly so device copies never outlive their host
+    # arrays just because the cache hasn't hit its size bound.
+    for k in [k for k, (xr, yr, *_dv) in _DATASET_CACHE.items() if xr() is None or yr() is None]:
+        del _DATASET_CACHE[k]
+    key = (id(key_x), id(key_y), int(cfg["seed"]), int(len(perm)), cfg["input_shape"])
+    hit = _DATASET_CACHE.get(key)
+    if hit is not None:
+        xref, yref, xd, yd = hit
+        if xref() is key_x and yref() is key_y:
+            return xd, yd
+    xd, yd = jnp.asarray(xp[perm]), jnp.asarray(yp[perm])
+    try:
+        xref, yref = weakref.ref(key_x), weakref.ref(key_y)
+    except TypeError:
+        return xd, yd  # un-weakref-able input (e.g. a list): don't cache
+    if len(_DATASET_CACHE) >= 4:
+        _DATASET_CACHE.clear()  # datasets are big; keep device HBM bounded
+    _DATASET_CACHE[key] = (xref, yref, xd, yd)
+    return xd, yd
+
+
 def _pop_bucket(n: int) -> int:
     """Round SMALL population batches up to a power of two (≤ 16).
 
@@ -686,15 +731,17 @@ class GeneticCnnModel(GentunModel):
 
         if not cfg["fold_parallel"]:
             accs = _run_segmented(
-                cfg, stacked, params, fold_keys, x[perm], y[perm],
+                cfg, stacked, params, fold_keys,
+                *_device_dataset(x_train, y_train, x, y, perm, cfg),
                 val_idx, val_weight, batch_idx, mesh, batch_size, n_tr, n_val_padded,
             )
             return accs.mean(axis=0)[:n_real]
 
         fn = _population_cv_fn(*_static_key(cfg, batch_size, n_tr, n_val_padded))
+        x_dev, y_dev = _device_dataset(x_train, y_train, x, y, perm, cfg)
         arrays = dict(
-            x_full=jnp.asarray(x[perm]),
-            y_full=jnp.asarray(y[perm]),
+            x_full=x_dev,
+            y_full=y_dev,
             val_idx=jnp.asarray(val_idx),
             val_weight=jnp.asarray(val_weight),
             batch_idx=jnp.asarray(batch_idx),

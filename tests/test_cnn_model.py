@@ -292,3 +292,40 @@ class TestPopBucketing:
             x, y, [{"S_1": (1, 0, 1)}] * 3, **{**FAST, "pop_padding": False}
         )
         assert accs.shape == (3,)
+
+
+class TestDeviceDatasetCache:
+    def test_cache_hits_across_calls_even_with_conversion(self, separable_data):
+        """The cache keys on the CALLER's arrays, so flat inputs (reshaped
+        fresh every call by _prepare_data) still hit."""
+        from gentun_tpu.models import cnn as cnn_mod
+
+        x, y = separable_data
+        flat = np.ascontiguousarray(x.reshape(x.shape[0], -1))  # stable caller object
+        cnn_mod._DATASET_CACHE.clear()
+        cfg = {**FAST, "input_shape": (8, 8, 1)}
+        GeneticCnnModel.cross_validate_population(flat, y, [{"S_1": (1, 0, 1)}], **cfg)
+        assert len(cnn_mod._DATASET_CACHE) == 1
+        (xref, yref, xd, yd) = next(iter(cnn_mod._DATASET_CACHE.values()))
+        GeneticCnnModel.cross_validate_population(flat, y, [{"S_1": (0, 1, 0)}], **cfg)
+        assert len(cnn_mod._DATASET_CACHE) == 1
+        (xref2, yref2, xd2, yd2) = next(iter(cnn_mod._DATASET_CACHE.values()))
+        assert xd2 is xd  # same device copy reused, no re-upload
+        assert xref() is flat
+
+    def test_dead_entries_evicted_on_lookup(self):
+        from gentun_tpu.models import cnn as cnn_mod
+
+        cnn_mod._DATASET_CACHE.clear()
+        rng = np.random.default_rng(0)
+        xa = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+        ya = rng.integers(0, 2, size=64).astype(np.int32)
+        GeneticCnnModel.cross_validate_population(xa, ya, [{"S_1": (1, 0, 1)}], **FAST)
+        assert len(cnn_mod._DATASET_CACHE) == 1
+        del xa  # host array dies → entry must be evicted on next lookup
+        xb = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+        yb = rng.integers(0, 2, size=64).astype(np.int32)
+        GeneticCnnModel.cross_validate_population(xb, yb, [{"S_1": (1, 0, 1)}], **FAST)
+        assert len(cnn_mod._DATASET_CACHE) == 1  # dead entry gone, live one present
+        (xref, *_rest) = next(iter(cnn_mod._DATASET_CACHE.values()))
+        assert xref() is xb
