@@ -104,6 +104,8 @@ def forward_flops_per_image() -> float:
 
 def schedule_flops(cfg: dict, pop: int) -> float:
     """Total executed conv/dense FLOPs for one cross_validate_population call."""
+    from gentun_tpu.models.cnn import _eval_batch_size
+
     fwd = forward_flops_per_image()
     kfold = cfg["kfold"]
     batch = cfg["batch_size"]
@@ -111,7 +113,8 @@ def schedule_flops(cfg: dict, pop: int) -> float:
     n_tr = N_DATA - fold_size
     steps_per_epoch = max(n_tr // batch, 1)
     total_steps = sum(cfg["epochs"]) * steps_per_epoch
-    n_val_padded = int(np.ceil(fold_size / batch)) * batch
+    # mirror the model's actual eval padding (gentun_tpu.models.cnn)
+    _, n_val_padded = _eval_batch_size(batch, fold_size)
     train = total_steps * batch * 3.0 * fwd  # bwd ≈ 2× fwd
     evalf = n_val_padded * fwd
     return pop * kfold * (train + evalf)

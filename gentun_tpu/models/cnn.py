@@ -169,9 +169,15 @@ def _training_primitives(
     n_train: int,
     n_val_padded: int,
     stage_exit_conv: bool,
+    eval_batch_size: int,
 ):
     """Shared, unjitted builders both executors compose: the model, the
     optimizer (staged-LR SGD), a train-segment function, and the fold eval.
+
+    ``eval_batch_size`` may exceed ``batch_size``: the validation pass is
+    forward-only (no optimizer state, no activations kept for backward), so
+    larger batches amortise per-batch overhead and widen the MXU work with
+    no memory downside.
 
     There is exactly ONE definition of the schedule-boundary math, the loss,
     and the eval weighting — the fused (:func:`_population_cv_fn`) and
@@ -231,15 +237,15 @@ def _training_primitives(
 
     def eval_fold(params, masks, x_full, y_full, val_idx, val_weight):
         def eval_batch(correct, start):
-            idx_b = jax.lax.dynamic_slice_in_dim(val_idx, start, batch_size, axis=0)
-            wb = jax.lax.dynamic_slice_in_dim(val_weight, start, batch_size, axis=0)
+            idx_b = jax.lax.dynamic_slice_in_dim(val_idx, start, eval_batch_size, axis=0)
+            wb = jax.lax.dynamic_slice_in_dim(val_weight, start, eval_batch_size, axis=0)
             xb = jnp.take(x_full, idx_b, axis=0)
             yb = jnp.take(y_full, idx_b, axis=0)
             logits = model.apply({"params": params}, xb, masks, train=False)
             hits = (jnp.argmax(logits, axis=-1) == yb).astype(jnp.float32)
             return correct + jnp.sum(hits * wb), None
 
-        starts = jnp.arange(0, n_val_padded, batch_size)
+        starts = jnp.arange(0, n_val_padded, eval_batch_size)
         correct, _ = jax.lax.scan(eval_batch, jnp.float32(0.0), starts)
         return correct / jnp.maximum(val_weight.sum(), 1.0)
 
@@ -272,22 +278,7 @@ def _population_cv_fn(*static_key):
 
 
 @functools.lru_cache(maxsize=32)
-def _fold_segment_fns(
-    nodes: Tuple[int, ...],
-    filters: Tuple[int, ...],
-    dense_units: int,
-    n_classes: int,
-    dropout_rate: float,
-    compute_dtype: str,
-    epochs: Tuple[int, ...],
-    learning_rate: Tuple[float, ...],
-    momentum: float,
-    nesterov: bool,
-    batch_size: int,
-    n_train: int,
-    n_val_padded: int,
-    stage_exit_conv: bool,
-):
+def _fold_segment_fns(*static_key):
     """Per-fold building blocks for SEGMENTED execution (the default path).
 
     Returns ``(init_pop, train_pop, eval_pop)``, each jitted with the
@@ -303,24 +294,10 @@ def _fold_segment_fns(
 
     Same lru-cached-by-static-config pattern as :func:`_population_cv_fn`;
     the two factories share :func:`_training_primitives`, differing only in
-    how the fold/step axes are driven (fused vmap vs host loop).
+    how the fold/step axes are driven (fused vmap vs host loop).  The
+    static key is exactly :func:`_static_key`'s tuple.
     """
-    _, tx, train_segment, eval_fold = _training_primitives(
-        nodes,
-        filters,
-        dense_units,
-        n_classes,
-        dropout_rate,
-        compute_dtype,
-        epochs,
-        learning_rate,
-        momentum,
-        nesterov,
-        batch_size,
-        n_train,
-        n_val_padded,
-        stage_exit_conv,
-    )
+    _, tx, train_segment, eval_fold = _training_primitives(*static_key)
     init_pop = jax.jit(jax.vmap(tx.init))
     # Donate the carries: each call consumes the previous segment's params /
     # opt state / rng, halving peak HBM versus keeping both generations.
@@ -332,7 +309,26 @@ def _fold_segment_fns(
     return init_pop, train_pop, eval_pop
 
 
-def _static_key(cfg: Dict[str, Any], batch_size: int, n_train: int, n_val_padded: int) -> Tuple:
+def _eval_batch_size(batch_size: int, n_val: int) -> Tuple[int, int]:
+    """(eval_batch_size, n_val_padded) for a validation block of n_val rows.
+
+    Forward-only eval takes up to 4× the train batch — fewer scan
+    iterations, wider MXU work, no backward-memory cost.  The batch is
+    sized by dividing the block into the fewest ≤4×batch segments rather
+    than fixing it at 4×batch, so padding never exceeds what the train
+    batch size alone would cause (plus segment-count rounding), instead of
+    up to ~60% for unlucky block sizes.
+    """
+    if n_val <= 0:
+        return batch_size, 0
+    rounded = int(np.ceil(n_val / batch_size)) * batch_size
+    n_seg = max(1, int(np.ceil(rounded / (4 * batch_size))))
+    eval_bs = int(np.ceil(rounded / n_seg))
+    return eval_bs, eval_bs * n_seg
+
+
+def _static_key(cfg: Dict[str, Any], batch_size: int, n_train: int, n_val_padded: int,
+                eval_batch_size: int) -> Tuple:
     """The ONE definition of the compiled-program static key.
 
     Both lru-cached factories (:func:`_population_cv_fn`,
@@ -355,6 +351,7 @@ def _static_key(cfg: Dict[str, Any], batch_size: int, n_train: int, n_val_padded
         n_train,
         n_val_padded,
         bool(cfg["stage_exit_conv"]),
+        eval_batch_size,
     )
 
 
@@ -381,6 +378,7 @@ def _run_segmented(
     batch_size: int,
     n_train: int,
     n_val_padded: int,
+    eval_batch_size: int,
 ) -> np.ndarray:
     """Host loop over folds × bounded segments; returns (kfold, P) accs.
 
@@ -391,7 +389,7 @@ def _run_segmented(
     single-program path remains available via ``fold_parallel=True``.
     """
     init_pop, train_pop, eval_pop = _fold_segment_fns(
-        *_static_key(cfg, batch_size, n_train, n_val_padded)
+        *_static_key(cfg, batch_size, n_train, n_val_padded, eval_batch_size)
     )
     x_full, y_full = jnp.asarray(x_np), jnp.asarray(y_np)
     masks = stacked
@@ -701,7 +699,7 @@ class GeneticCnnModel(GentunModel):
         n_tr = n_use - fold_size
         steps_per_epoch = max(n_tr // batch_size, 1)
         total_steps = sum(cfg["epochs"]) * steps_per_epoch
-        n_val_padded = int(np.ceil(fold_size / batch_size)) * batch_size
+        eval_bs, n_val_padded = _eval_batch_size(batch_size, fold_size)
         pad = n_val_padded - fold_size
 
         # Per-fold index arrays (host-side numpy, tiny): the fold IS its
@@ -733,11 +731,12 @@ class GeneticCnnModel(GentunModel):
             accs = _run_segmented(
                 cfg, stacked, params, fold_keys,
                 *_device_dataset(x_train, y_train, x, y, perm, cfg),
-                val_idx, val_weight, batch_idx, mesh, batch_size, n_tr, n_val_padded,
+                val_idx, val_weight, batch_idx, mesh, batch_size, n_tr,
+                n_val_padded, eval_bs,
             )
             return accs.mean(axis=0)[:n_real]
 
-        fn = _population_cv_fn(*_static_key(cfg, batch_size, n_tr, n_val_padded))
+        fn = _population_cv_fn(*_static_key(cfg, batch_size, n_tr, n_val_padded, eval_bs))
         x_dev, y_dev = _device_dataset(x_train, y_train, x, y, perm, cfg)
         arrays = dict(
             x_full=x_dev,
@@ -798,7 +797,7 @@ class GeneticCnnModel(GentunModel):
         batch_size = min(cfg["batch_size"], n_tr)
         steps_per_epoch = max(n_tr // batch_size, 1)
         total_steps = sum(cfg["epochs"]) * steps_per_epoch
-        n_val_padded = int(np.ceil(n_te / batch_size)) * batch_size
+        eval_bs, n_val_padded = _eval_batch_size(batch_size, n_te)
         pad = n_val_padded - n_te
 
         rng = np.random.default_rng(cfg["seed"])
@@ -821,7 +820,8 @@ class GeneticCnnModel(GentunModel):
         # watchdog-safe here too).
         accs = _run_segmented(
             cfg, stacked, params, keys, x_full, y_full,
-            val_idx, val_weight, batch_idx, mesh, batch_size, n_tr, n_val_padded,
+            val_idx, val_weight, batch_idx, mesh, batch_size, n_tr,
+            n_val_padded, eval_bs,
         )
         return accs[0][:n_real]
 

@@ -329,3 +329,22 @@ class TestDeviceDatasetCache:
         assert len(cnn_mod._DATASET_CACHE) == 1  # dead entry gone, live one present
         (xref, *_rest) = next(iter(cnn_mod._DATASET_CACHE.values()))
         assert xref() is xb
+
+
+def test_eval_batch_size_properties():
+    from gentun_tpu.models.cnn import _eval_batch_size
+
+    for bs in (32, 128, 256):
+        for n_val in (0, 1, bs - 1, bs, bs + 1, 4 * bs, 4 * bs + 1, 513, 5000):
+            eval_bs, nvp = _eval_batch_size(bs, n_val)
+            assert nvp >= n_val
+            if n_val == 0:
+                assert nvp == 0
+                continue
+            assert nvp % eval_bs == 0  # eval scan covers the block exactly
+            assert eval_bs <= 4 * bs + bs  # bounded batch
+            # padding never exceeds one train batch + segment rounding
+            assert nvp - n_val < bs + int(np.ceil(nvp / eval_bs))
+    # the reviewer's unlucky case: fold 513 @ batch 128 wastes ≤ one batch
+    eval_bs, nvp = _eval_batch_size(128, 513)
+    assert nvp == 640 and eval_bs == 320
