@@ -342,7 +342,7 @@ def test_eval_batch_size_properties():
                 assert nvp == 0
                 continue
             assert nvp % eval_bs == 0  # eval scan covers the block exactly
-            assert eval_bs <= 4 * bs + bs  # bounded batch
+            assert eval_bs <= 4 * bs  # the documented eval-width bound
             # padding never exceeds one train batch + segment rounding
             assert nvp - n_val < bs + int(np.ceil(nvp / eval_bs))
     # the reviewer's unlucky case: fold 513 @ batch 128 wastes ≤ one batch

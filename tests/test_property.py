@@ -1,0 +1,83 @@
+"""Property-based operator tests (SURVEY.md §4: "operators ... determinism
+under a seeded PRNG"; hypothesis is part of the prescribed toolbox).
+
+These pin the algebraic contracts of the genome layer for ALL inputs, not
+just the examples the unit tests chose: crossover only ever copies parental
+genes, mutation preserves validity and respects rate extremes, sampling is
+deterministic under a seed, and validation round-trips.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from gentun_tpu.genes import boosting_genome, genetic_cnn_genome
+
+nodes_st = st.lists(st.integers(min_value=1, max_value=5), min_size=1, max_size=3).map(tuple)
+seed_st = st.integers(min_value=0, max_value=2**31 - 1)
+
+
+@st.composite
+def cnn_genomes(draw):
+    nodes = draw(nodes_st)
+    spec = genetic_cnn_genome(nodes)
+    seed = draw(seed_st)
+    return nodes, spec, spec.sample(np.random.default_rng(seed))
+
+
+@settings(max_examples=50, deadline=None)
+@given(cnn_genomes(), seed_st)
+def test_sampling_is_deterministic_and_valid(data, seed):
+    nodes, spec, genome = data
+    a = spec.sample(np.random.default_rng(seed))
+    b = spec.sample(np.random.default_rng(seed))
+    assert a == b  # same seed, same genome
+    assert spec.validate(a) == a  # sampled genomes validate unchanged
+    for s, k in enumerate(nodes):
+        assert len(a[f"S_{s + 1}"]) == k * (k - 1) // 2
+
+
+@settings(max_examples=50, deadline=None)
+@given(cnn_genomes(), seed_st, seed_st, st.floats(min_value=0.0, max_value=1.0))
+def test_crossover_only_copies_parental_genes(data, seed_b, seed_cx, rate):
+    nodes, spec, mother = data
+    father = spec.sample(np.random.default_rng(seed_b))
+    child = spec.crossover(mother, father, np.random.default_rng(seed_cx), rate)
+    assert set(child) == set(mother)
+    for name, value in child.items():
+        assert value == mother[name] or value == father[name]
+    # determinism: same rng seed, same child
+    child2 = spec.crossover(mother, father, np.random.default_rng(seed_cx), rate)
+    assert child == child2
+
+
+@settings(max_examples=50, deadline=None)
+@given(cnn_genomes(), seed_st)
+def test_mutation_rate_extremes(data, seed):
+    nodes, spec, genome = data
+    rng = np.random.default_rng(seed)
+    same = spec.mutate(genome, rng, 0.0)
+    assert same == genome  # rate 0: identity
+    flipped = spec.mutate(genome, np.random.default_rng(seed), 1.0)
+    for s in range(len(nodes)):
+        name = f"S_{s + 1}"
+        assert all(a != b for a, b in zip(genome[name], flipped[name])) or len(genome[name]) == 0
+
+
+@settings(max_examples=50, deadline=None)
+@given(cnn_genomes(), seed_st, st.floats(min_value=0.0, max_value=1.0))
+def test_mutation_output_always_validates(data, seed, rate):
+    nodes, spec, genome = data
+    mutated = spec.mutate(genome, np.random.default_rng(seed), rate)
+    assert spec.validate(mutated) == mutated
+
+
+@settings(max_examples=50, deadline=None)
+@given(seed_st, seed_st, st.floats(min_value=0.0, max_value=1.0))
+def test_boosting_genome_operators_stay_in_bounds(seed_a, seed_b, rate):
+    spec = boosting_genome()
+    rng = np.random.default_rng(seed_a)
+    a = spec.sample(rng)
+    b = spec.sample(np.random.default_rng(seed_b))
+    child = spec.mutate(spec.crossover(a, b, rng, rate), rng, rate)
+    validated = spec.validate(child)
+    assert validated == child  # every operator output is in-bounds
