@@ -19,6 +19,8 @@ Public API mirrors the reference (``gentun/__init__.py`` [PUB]; SURVEY.md
 optional dependency never breaks ``import gentun_tpu``.
 """
 
+__version__ = "0.2.0"  # keep in sync with pyproject.toml
+
 from .genes import (
     BinaryGene,
     ChoiceGene,
