@@ -19,8 +19,6 @@ Public API mirrors the reference (``gentun/__init__.py`` [PUB]; SURVEY.md
 optional dependency never breaks ``import gentun_tpu``.
 """
 
-__version__ = "0.2.0"  # keep in sync with pyproject.toml
-
 from .genes import (
     BinaryGene,
     ChoiceGene,
@@ -54,7 +52,7 @@ __all__ = [
     "RussianRouletteGA",
 ]
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml
 
 # Fitness models pull in jax/flax/sklearn; keep them optional at import time,
 # matching the reference's try/except around model imports (SURVEY.md §2.0
