@@ -50,13 +50,13 @@ def _initialized_chip_count() -> int:
 
     if "jax" not in sys.modules:
         return 1
-    try:
-        from jax._src import xla_bridge
+    from .utils.jax_state import backend_used
 
-        if not xla_bridge._backends:  # backend never initialized: don't force it
-            return 1
+    if not backend_used():  # backend never initialized: don't force it
+        return 1
+    try:
         return sys.modules["jax"].local_device_count()
-    except Exception:  # pragma: no cover - private-API drift
+    except Exception:  # pragma: no cover - defensive
         return 1
 
 

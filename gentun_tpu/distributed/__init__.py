@@ -10,6 +10,7 @@ device collectives stay inside each worker (ICI, via jax).
 
 from .broker import GatherTimeout, JobBroker, JobFailed
 from .client import GentunClient
+from .protocol import AuthError
 from .server import DistributedGridPopulation, DistributedPopulation
 
 __all__ = [
@@ -17,6 +18,7 @@ __all__ = [
     "JobFailed",
     "GatherTimeout",
     "GentunClient",
+    "AuthError",
     "DistributedPopulation",
     "DistributedGridPopulation",
 ]

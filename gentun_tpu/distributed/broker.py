@@ -209,7 +209,12 @@ class JobBroker:
             loop.close()
 
     async def _serve(self) -> None:
-        self._server = await asyncio.start_server(self._handle_worker, self._host, self._port)
+        # Reader limit must cover a full protocol frame: the default 64 KiB
+        # StreamReader limit would kill legitimate (if large) worker frames
+        # with a LimitOverrunError instead of the clean ProtocolError path.
+        self._server = await asyncio.start_server(
+            self._handle_worker, self._host, self._port, limit=MAX_MESSAGE_BYTES + 2
+        )
         sock = self._server.sockets[0]
         self._bound = sock.getsockname()[:2]
         self._reaper_task = asyncio.ensure_future(self._reaper())
@@ -354,7 +359,11 @@ class JobBroker:
                 # generation per attempt.
                 self._pending = deque(j for j in self._pending if j not in ids)
             for w in self._workers.values():
+                # Restore the credit _dispatch deducted for cancelled jobs,
+                # so the worker's next batch isn't shrunk for one cycle.
+                cancelled_here = len(w.in_flight & ids)
                 w.in_flight -= ids
+                w.credit = min(w.capacity, w.credit + cancelled_here)
             # Late sweep: a result that was mid-delivery when gather pruned
             # (past the payload check, blocked on _cond) lands in _results
             # BEFORE this callback runs — handler and callbacks share the
@@ -454,7 +463,9 @@ class JobBroker:
                 str(hello.get("token") or "").encode("utf-8"),
                 self._token.encode("utf-8"),
             ):
-                writer.write(encode({"type": "error", "reason": "bad token"}))
+                # code=auth lets the client distinguish a deterministic
+                # credential rejection (terminal) from transient errors.
+                writer.write(encode({"type": "error", "code": "auth", "reason": "bad token"}))
                 logger.warning("worker rejected: bad token")
                 return
             worker = _Worker(
@@ -476,7 +487,11 @@ class JobBroker:
                 if mtype == "ping":
                     self._send(worker, {"type": "pong"})
                 elif mtype == "ready":
-                    worker.credit = min(worker.capacity, worker.credit + int(msg.get("credit", 1)))
+                    try:
+                        add = int(msg.get("credit", 1))
+                    except (TypeError, ValueError):
+                        add = 1  # malformed credit: degrade, don't drop the worker
+                    worker.credit = min(worker.capacity, worker.credit + add)
                     self._dispatch()
                 elif mtype == "result":
                     self._on_result(worker, msg)
@@ -484,7 +499,9 @@ class JobBroker:
                     self._on_fail(worker, msg)
                 else:
                     logger.warning("unknown message type %r from %s", mtype, worker.worker_id)
-        except (ProtocolError, ConnectionError, asyncio.IncompleteReadError) as e:
+        except (ProtocolError, ConnectionError, asyncio.IncompleteReadError, ValueError) as e:
+            # ValueError covers StreamReader limit overruns (frame > limit),
+            # which should tear the connection down via the same cleanup path.
             logger.info("worker connection %d dropped: %s", wid, e)
         finally:
             if worker is not None:
@@ -495,13 +512,21 @@ class JobBroker:
 
     def _on_result(self, w: _Worker, msg: Dict[str, Any]) -> None:
         job_id = str(msg["job_id"])
+        # Parse BEFORE touching broker state: a malformed fitness must count
+        # as a worker-side failure (redeliverable), not delete the payload
+        # and lose the job for good.
+        try:
+            fitness = float(msg["fitness"])
+        except (KeyError, TypeError, ValueError):
+            self._on_fail(w, {"job_id": job_id, "reason": f"malformed fitness: {msg.get('fitness')!r}"})
+            return
         w.in_flight.discard(job_id)
         if job_id not in self._payloads:
             logger.info("duplicate/stale result for %s dropped (redelivery race)", job_id)
             return
         del self._payloads[job_id]
         with self._cond:
-            self._results[job_id] = float(msg["fitness"])
+            self._results[job_id] = fitness
             self._cond.notify_all()
 
     def _on_fail(self, w: _Worker, msg: Dict[str, Any]) -> None:

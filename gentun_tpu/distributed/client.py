@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional, Type
 
 from ..individuals import Individual
 from ..populations import Population
-from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
+from .protocol import MAX_MESSAGE_BYTES, AuthError, ProtocolError, decode, encode
 
 __all__ = ["GentunClient"]
 
@@ -94,6 +94,8 @@ class GentunClient:
         self._send({"type": "hello", "worker_id": self.worker_id, "token": self.token, "capacity": self.capacity})
         reply = self._recv()
         if reply.get("type") != "welcome":
+            if reply.get("type") == "error" and reply.get("code") == "auth":
+                raise AuthError(f"broker rejected credentials: {reply.get('reason')}")
             raise ConnectionError(f"broker rejected worker: {reply}")
         self._handshaken.set()
         logger.info("worker %s connected to %s:%d", self.worker_id, self.host, self.port)
@@ -155,6 +157,12 @@ class GentunClient:
                 try:
                     self._connect()
                     self._consume(stop, max_jobs)
+                except AuthError:
+                    # Deterministic rejection: reconnecting with the same
+                    # token can never succeed, so fail loudly instead of
+                    # spinning in the reconnect loop forever.
+                    logger.error("worker %s: broker rejected credentials; giving up", self.worker_id)
+                    raise
                 except (ConnectionError, OSError, ProtocolError) as e:
                     if stop.is_set() or (max_jobs is not None and self._jobs_done >= max_jobs):
                         break

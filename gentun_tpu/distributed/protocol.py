@@ -42,7 +42,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict
 
-__all__ = ["encode", "decode", "MAX_MESSAGE_BYTES", "ProtocolError"]
+__all__ = ["encode", "decode", "MAX_MESSAGE_BYTES", "ProtocolError", "AuthError"]
 
 #: Hard cap per message; genes + params are a few KB, so anything huge is a
 #: protocol violation (or an attempt to ship training data, which the design
@@ -52,6 +52,18 @@ MAX_MESSAGE_BYTES = 4 * 1024 * 1024
 
 class ProtocolError(Exception):
     """Malformed or oversized frame."""
+
+
+class AuthError(ConnectionError):
+    """The broker rejected this worker's credentials (``error: bad token``).
+
+    Unlike a network blip, auth rejection is deterministic — reconnecting
+    with the same token can never succeed — so ``GentunClient.work()``
+    treats it as TERMINAL instead of retrying forever (the reference's
+    RabbitMQ credential failure is equally loud [PUB]).  Subclasses
+    ``ConnectionError`` so pre-existing callers that catch broadly keep
+    working.
+    """
 
 
 def encode(msg: Dict[str, Any]) -> bytes:
@@ -64,6 +76,9 @@ def encode(msg: Dict[str, Any]) -> bytes:
 
 def decode(line: bytes) -> Dict[str, Any]:
     """One frame (without trailing newline requirement) → message dict."""
+    # Strip the framing newline before the size check so a payload of
+    # exactly MAX_MESSAGE_BYTES (which encode() allows) round-trips.
+    line = line.rstrip(b"\n")
     if len(line) > MAX_MESSAGE_BYTES:
         raise ProtocolError(f"frame of {len(line)} bytes exceeds {MAX_MESSAGE_BYTES}")
     try:

@@ -47,12 +47,14 @@ def _load_dataset(name: str, data_dir=None, n=None):
         raise SystemExit(f"--data-dir is not supported for dataset {name!r}")
     x, y, meta = loaders[name]()
     if n is not None:
-        # ...and is then ENFORCED here, uniformly: loaders apply `n` only on
-        # some code paths (e.g. not on npz overrides), so silently-ignored
-        # or unsatisfiable values become loud errors instead.
-        if n <= 0 or len(x) < n:
+        if len(x) < n:
+            # Loaders cannot conjure rows an npz archive or sklearn table
+            # doesn't have, so undersupply is a loud error here rather than
+            # a silently smaller dataset.
             raise SystemExit(f"--n {n} not satisfiable for {name!r} ({len(x)} examples available)")
         if len(x) > n:
+            # Only the UCI loaders reach here (the image loaders subsample
+            # to `n` themselves); enforce the flag uniformly regardless.
             idx = np.random.default_rng(0).permutation(len(x))[:n]
             x, y = x[idx], y[idx]
     return x, y, meta
@@ -99,6 +101,7 @@ def main(argv=None) -> int:
     )
 
     from .client import GentunClient
+    from .protocol import AuthError
 
     client = GentunClient(
         _species(args.species),
@@ -110,7 +113,10 @@ def main(argv=None) -> int:
         capacity=args.capacity,
         worker_id=args.worker_id,
     )
-    done = client.work(max_jobs=args.max_jobs)
+    try:
+        done = client.work(max_jobs=args.max_jobs)
+    except AuthError as e:
+        raise SystemExit(f"fatal: {e}")
     logging.getLogger("gentun_tpu.distributed").info("worker exiting after %d job(s)", done)
     return 0
 

@@ -61,6 +61,7 @@ import optax
 
 from ..ops.dag import stack_genome_masks
 from ..parallel.mesh import auto_mesh, pad_population, shard_cv_args
+from ..utils.jax_state import mark_backend_used
 from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
 from .generic import GentunModel
 
@@ -466,11 +467,29 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
     return _init_fn(model, tuple(input_shape))(keys, masks_stacked)
 
 
-#: (id(x_key), id(y_key), seed, n_use, input_shape) →
+#: (id(x_key), id(y_key), fingerprints, seed, n_use, input_shape) →
 #: (weakref(x_key), weakref(y_key), x_dev, y_dev).  Kept tiny (a handful of
 #: datasets); entries are validated by object identity through the
-#: weakrefs, so a recycled id can never alias.
+#: weakrefs, so a recycled id can never alias, and by a strided content
+#: fingerprint, so in-place mutation (e.g. per-generation augmentation)
+#: is detected instead of silently training on stale device data.
 _DATASET_CACHE: Dict[Tuple, Tuple[Any, Any, Any, Any]] = {}
+
+
+def _content_fingerprint(a) -> Tuple[Any, ...]:
+    """Cheap content hash: shape/dtype + a ≤1024-element strided sample.
+
+    O(1 KiB) regardless of dataset size, so it runs on every cache probe.
+    A mutation that misses every sampled element still goes undetected —
+    the documented contract remains "don't mutate in place" — but the
+    common cases (normalisation, augmentation, relabeling) touch enough of
+    the array to flip the sample with near-certainty.
+    """
+    arr = np.asarray(a)
+    flat = arr.ravel()
+    step = max(1, flat.size // 1024)
+    sample = np.ascontiguousarray(flat[::step][:1024])
+    return (arr.shape, str(arr.dtype), hash(sample.tobytes()))
 
 
 def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarray, cfg: Dict[str, Any]):
@@ -481,31 +500,51 @@ def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarr
     every generation even though the dataset never changes within a search.
 
     The cache is keyed by the identity of the CALLER's arrays (``key_x`` /
-    ``key_y`` — the objects a Population holds stable across generations),
-    never by the ``_prepare_data`` outputs, which are fresh objects on every
-    call whenever a reshape/dtype conversion happens.  The converted content
-    is a pure function of (caller array, input_shape), and the permutation
-    of (seed, n), so key identity + the cfg fields fully determine the
-    device content.  Like everything jax, this assumes arrays are not
-    mutated in place.
+    ``key_y`` — the objects a Population holds stable across generations)
+    plus a strided content fingerprint, never by the ``_prepare_data``
+    outputs, which are fresh objects on every call whenever a reshape/dtype
+    conversion happens.  The fingerprint turns the "arrays must not be
+    mutated in place" contract (documented on ``GeneticCnnModel``) from an
+    assumption into a near-certain cache miss when violated.  Eviction is
+    LRU one-at-a-time, so the hot dataset survives a fifth dataset showing
+    up; dead-referent entries are dropped eagerly.
     """
     # Evict dead entries eagerly so device copies never outlive their host
     # arrays just because the cache hasn't hit its size bound.
     for k in [k for k, (xr, yr, *_dv) in _DATASET_CACHE.items() if xr() is None or yr() is None]:
         del _DATASET_CACHE[k]
-    key = (id(key_x), id(key_y), int(cfg["seed"]), int(len(perm)), cfg["input_shape"])
+    key = (
+        id(key_x),
+        id(key_y),
+        _content_fingerprint(key_x),
+        _content_fingerprint(key_y),
+        int(cfg["seed"]),
+        int(len(perm)),
+        cfg["input_shape"],
+    )
     hit = _DATASET_CACHE.get(key)
     if hit is not None:
         xref, yref, xd, yd = hit
         if xref() is key_x and yref() is key_y:
+            _DATASET_CACHE[key] = _DATASET_CACHE.pop(key)  # LRU: refresh recency
             return xd, yd
+    # Same arrays, different fingerprint ⇒ the caller mutated in place; the
+    # predecessor entries can never hit again, so drop them now instead of
+    # pinning stale device copies of the same dataset until LRU catches up.
+    # (Same ids + same fingerprints with a different seed/n/shape are
+    # legitimate sibling entries — e.g. the holdout path — and stay.)
+    for k in [
+        k for k in _DATASET_CACHE
+        if k[0] == key[0] and k[1] == key[1] and (k[2], k[3]) != (key[2], key[3])
+    ]:
+        del _DATASET_CACHE[k]
     xd, yd = jnp.asarray(xp[perm]), jnp.asarray(yp[perm])
     try:
         xref, yref = weakref.ref(key_x), weakref.ref(key_y)
     except TypeError:
         return xd, yd  # un-weakref-able input (e.g. a list): don't cache
-    if len(_DATASET_CACHE) >= 4:
-        _DATASET_CACHE.clear()  # datasets are big; keep device HBM bounded
+    while len(_DATASET_CACHE) >= 4:  # datasets are big; keep device HBM bounded
+        _DATASET_CACHE.pop(next(iter(_DATASET_CACHE)))  # LRU eviction
     _DATASET_CACHE[key] = (xref, yref, xd, yd)
     return xd, yd
 
@@ -542,6 +581,11 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     cache_dir = cfg["cache_dir"] or default_cache_dir()
     if cache_dir:
         enable_compilation_cache(cache_dir)
+
+    # Everything below touches devices (auto_mesh → jax.devices()); record
+    # that publicly so the GA's per-chip metric can consult device counts
+    # without ever being the thing that forces backend init (utils/jax_state).
+    mark_backend_used()
 
     # Multi-chip: shard the population axis over the mesh (and the train
     # batch over its data axis).  Pad so the pop axis divides evenly;
@@ -601,6 +645,12 @@ class GeneticCnnModel(GentunModel):
     vmap-folds path; ``stage_exit_conv`` adds the Xie & Yuille output-node
     conv; ``mesh``/``cache_dir`` control sharding and the persistent
     compilation cache.
+
+    Data contract: ``x_train``/``y_train`` are treated as immutable — the
+    permuted dataset is cached on device across ``evaluate()`` calls, keyed
+    by array identity plus a strided content fingerprint.  Mutating them in
+    place between calls is detected (near-certainly) and triggers a
+    re-upload; prefer replacing the arrays to mutating them.
     """
 
     def __init__(

@@ -1,0 +1,169 @@
+"""Multi-host (multi-controller) execution: one worker spanning a pod slice.
+
+The north-star topology is a v5e-32 — an 8-host slice — where ONE logical
+worker owns 32 chips (BASELINE config #4 "multi-host TPU-VM workers").
+Under jax's multi-controller model that worker is N processes (one per
+host) running the SAME program over a global device mesh; collectives ride
+ICI between the hosts' chips, and only process 0 talks to the master's
+broker over DCN.
+
+This module is the thin, fully-public-API seam that makes the rest of the
+framework multi-process-safe:
+
+- :func:`initialize` — ``jax.distributed.initialize`` wrapper the worker
+  CLI calls before any backend init;
+- :func:`place` / :func:`place_tree` — put a host-replicated array onto a
+  (possibly cross-process) ``NamedSharding``.  Single-process this is
+  exactly ``jax.device_put``; multi-process it goes through
+  ``jax.make_array_from_process_local_data``, which is the blessed way to
+  assemble a global array when every host holds the full value (our data
+  pipeline is deterministic per-seed, so every host *does* — SURVEY.md §1
+  "workers own the training data");
+- :func:`fetch` — the inverse: global (possibly non-addressable) device
+  array → full numpy array on every process, via
+  ``multihost_utils.process_allgather``;
+- :func:`broadcast_payload` — ship one process's Python object (job
+  payloads off the broker) to all processes as two fixed-shape collectives
+  (length, then a padded byte buffer), so follower processes can run the
+  same evaluation program the leader runs.
+
+Design rule enforced here: every cross-process interaction goes through
+jax collectives over the device fabric — there is NO side-channel
+host networking between a worker's processes (the broker connection
+belongs to process 0 alone).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+
+__all__ = [
+    "initialize",
+    "process_count",
+    "process_index",
+    "is_leader",
+    "place",
+    "place_tree",
+    "fetch",
+    "broadcast_payload",
+]
+
+logger = logging.getLogger("gentun_tpu")
+
+
+def initialize(
+    coordinator: str,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or found) a multi-process jax cluster.
+
+    Must run before anything initializes a jax backend; after it,
+    ``jax.devices()`` is the GLOBAL device list and ``auto_mesh`` therefore
+    builds pod-slice-wide meshes with no further changes.
+
+    On TPU pods, ``num_processes``/``process_id`` may be ``None`` — jax
+    infers them from the TPU metadata.  On CPU/GPU clusters they are
+    required.
+    """
+    kwargs: dict = {"coordinator_address": coordinator}
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    jax.distributed.initialize(**kwargs)
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def process_count() -> int:
+    """Processes in the cluster (1 when jax.distributed was never initialized)."""
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_leader() -> bool:
+    """True on the process that owns external I/O (broker connection, logs)."""
+    return jax.process_index() == 0
+
+
+def place(x: Any, sharding) -> jax.Array:
+    """Host value → device array under ``sharding``, multi-process-safe.
+
+    Requires the host value to be identical on every process (deterministic
+    pipelines guarantee this); each process contributes exactly its
+    addressable shards.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    # global_shape == local shape tells jax every process holds the FULL
+    # array; it slices out each process's addressable shards locally.
+    return jax.make_array_from_process_local_data(sharding, x, x.shape)
+
+
+def place_tree(tree: Any, sharding) -> Any:
+    """:func:`place` over a pytree (one sharding for every leaf)."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+    return jax.tree.map(lambda leaf: place(leaf, sharding), tree)
+
+
+def fetch(x: jax.Array) -> np.ndarray:
+    """Global device array → full numpy value on every process.
+
+    Single-process this is ``np.asarray``; multi-process it all-gathers the
+    non-addressable shards first (every process gets the same full array,
+    keeping the SPMD programs in lockstep).
+    """
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def _bucket_bytes(n: int) -> int:
+    """Fixed-shape buckets (powers of two ≥ 256) bound broadcast recompiles."""
+    b = 256
+    while b < n:
+        b *= 2
+    return b
+
+
+def broadcast_payload(obj: Any = None) -> Any:
+    """Ship process 0's JSON-serializable object to every process.
+
+    Callers on process 0 pass the object; followers pass anything (ignored)
+    and receive process 0's value.  Two collectives: a scalar length, then
+    a padded uint8 buffer whose bucketed size all processes derive from the
+    broadcast length — fixed shapes, so jax caches the compiled programs.
+    """
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return obj
+    if is_leader():
+        data = json.dumps(obj).encode("utf-8")
+    else:
+        data = b""
+    n = int(multihost_utils.broadcast_one_to_all(np.int64(len(data))))
+    buf = np.zeros(_bucket_bytes(n), dtype=np.uint8)
+    if is_leader():
+        buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return json.loads(bytes(out[:n]).decode("utf-8"))

@@ -95,6 +95,12 @@ def load_mnist(n: Optional[int] = None, data_dir: Optional[str] = None, seed: in
             meta = {"synthetic": False, "source": "sklearn.load_digits upscaled 8x8→28x28"}
         except ImportError:  # pragma: no cover
             x, y, meta = synthetic_images(4096, (28, 28, 1), 10, seed=seed)
+    return _subsample((x, y, meta), n, seed)
+
+
+def _subsample(found: Arrays, n: Optional[int], seed: int) -> Arrays:
+    """Uniform random subsample to ``n`` rows (no-op when n >= len)."""
+    x, y, meta = found
     if n is not None and n < len(x):
         idx = np.random.default_rng(seed).permutation(len(x))[:n]
         x, y = x[idx], y[idx]
@@ -105,11 +111,7 @@ def load_cifar10(n: int = 10_000, data_dir: Optional[str] = None, seed: int = 0)
     """32×32×3, 10 classes (BASELINE config #2)."""
     found = _try_npz(data_dir, "cifar10", (32, 32, 3))
     if found is not None:
-        x, y, meta = found
-        if n < len(x):
-            idx = np.random.default_rng(seed).permutation(len(x))[:n]
-            x, y = x[idx], y[idx]
-        return x, y, meta
+        return _subsample(found, n, seed)
     return synthetic_images(n, (32, 32, 3), 10, seed=seed)
 
 
@@ -117,7 +119,7 @@ def load_cifar100(n: int = 10_000, data_dir: Optional[str] = None, seed: int = 0
     """32×32×3, 100 classes (BASELINE config #5)."""
     found = _try_npz(data_dir, "cifar100", (32, 32, 3))
     if found is not None:
-        return found
+        return _subsample(found, n, seed)
     return synthetic_images(n, (32, 32, 3), 100, seed=seed)
 
 

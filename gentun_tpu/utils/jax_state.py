@@ -1,0 +1,29 @@
+"""Tracks whether this process's fitness path has initialized a jax backend.
+
+The GA outer loop (``algorithms.py``) is pure bookkeeping and must never
+trigger (or hang on) TPU runtime initialization just to normalise the
+individuals/hour/chip metric.  jax offers no public "is a backend already
+live?" probe, so instead of poking ``jax._src`` internals the fitness
+entry points — the only code in this package that touches devices —
+call :func:`mark_backend_used` right before their first device access,
+and the GA consults :func:`backend_used`.
+
+A false negative (some exotic caller touches jax outside the fitness
+entry points) only degrades the metric to per-host instead of per-chip;
+it can never force a backend init.
+"""
+
+from __future__ import annotations
+
+_backend_used = False
+
+
+def mark_backend_used() -> None:
+    """Record that a jax backend has been (or is about to be) initialized."""
+    global _backend_used
+    _backend_used = True
+
+
+def backend_used() -> bool:
+    """True once any fitness entry point has touched jax devices."""
+    return _backend_used

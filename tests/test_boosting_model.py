@@ -72,6 +72,31 @@ def test_scale_pos_weight_trains_on_non01_labels():
     assert acc > 0.7
 
 
+def test_scale_pos_weight_direction_upweights_positive_class():
+    """ADVICE r2: assert the weighting DIRECTION behaviorally.  A large
+    scale_pos_weight on heavily imbalanced data must raise the positive
+    (= second sorted) class's recall versus the unweighted model; if a
+    future sklearn keyed class_weight off original labels instead of
+    label-encoded ones, {1,2}-labeled data would weight the wrong class
+    (or raise) and this test would catch it."""
+    rng = np.random.default_rng(3)
+    n = 1500
+    x = rng.normal(size=(n, 6))
+    logits = x[:, 0] + 0.5 * x[:, 1] + rng.normal(scale=2.0, size=n)
+    y = np.where(logits > 2.2, 2, 1).astype(np.int64)  # positives rare, labels {1,2}
+    assert 0.02 < (y == 2).mean() < 0.25
+    tr, te = np.arange(n) < 1000, np.arange(n) >= 1000
+
+    def positive_recall(extra_genes):
+        genes = {"max_depth": 3, "eta": 0.1, **extra_genes}
+        model = BoostingModel(x[tr], y[tr], genes, early_stopping=False)._build()
+        model.fit(x[tr], y[tr])
+        pred = model.predict(x[te])
+        return float((pred[y[te] == 2] == 2).mean())
+
+    assert positive_recall({"scale_pos_weight": 50.0}) > positive_recall({})
+
+
 def test_sklearn_gene_shadows_xgboost_twin():
     """Mixed genomes: explicit sklearn keys win; twins are shadowed, never
     silently merged or misreported as unmappable."""

