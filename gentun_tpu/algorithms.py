@@ -162,6 +162,14 @@ class GeneticAlgorithm:
             # the north-star metric (BASELINE.json): individuals/hour/chip
             "individuals_per_hour_per_chip": round(evaluated / (elapsed_s / 3600.0) / n_chips, 2),
         }
+        # Distributed populations report their failure-recovery bookkeeping
+        # (bounded retries / penalized stragglers) — record it so a resumed
+        # or audited search can see exactly which generations degraded.
+        stats = getattr(self.population, "eval_stats", None)
+        if stats and (stats.get("retries") or stats.get("penalized")):
+            record["evaluate_attempts"] = stats["attempts"]
+            record["evaluate_retries"] = stats["retries"]
+            record["penalized"] = stats["penalized"]
         self.history.append(record)
         logger.info("generation %s", json.dumps(record, default=str))
 

@@ -50,6 +50,13 @@ class GentunClient:
     - ``capacity``: max jobs held at once (1 = reference semantics; >1 lets
       a TPU worker train a whole batch in one compiled program).
     - ``heartbeat_interval``: seconds between pings from the side thread.
+    - ``multihost``: this worker is ONE logical worker spanning a
+      multi-process jax cluster (``jax.distributed`` already initialized —
+      see ``parallel/multihost.py``).  Process 0 alone owns the broker
+      connection; every process executes the same evaluation program, with
+      job payloads broadcast over the device fabric.  Off by default so
+      single-host workers (and non-jax species) never touch a jax backend
+      just to consume jobs.
     """
 
     def __init__(
@@ -65,6 +72,7 @@ class GentunClient:
         heartbeat_interval: float = 3.0,
         reconnect_delay: float = 1.0,
         worker_id: Optional[str] = None,
+        multihost: bool = False,
     ):
         self.species = species
         self.x_train = x_train
@@ -76,6 +84,15 @@ class GentunClient:
         self.heartbeat_interval = float(heartbeat_interval)
         self.reconnect_delay = float(reconnect_delay)
         self.worker_id = worker_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self.multihost = bool(multihost)
+        if self.multihost:
+            from ..parallel import multihost as mh  # imports jax (opt-in only)
+
+            self._mh = mh
+            self._is_leader = mh.is_leader()
+        else:
+            self._mh = None
+            self._is_leader = True
 
         self._sock: Optional[socket.socket] = None
         self._rfile = None
@@ -146,7 +163,16 @@ class GentunClient:
 
         Returns the number of jobs completed (useful for tests); runs until
         ``stop_event`` is set or ``max_jobs`` results have been sent.
+
+        Multi-host mode: process 0 runs this loop against the broker and
+        broadcasts each received batch; processes > 0 never touch the
+        socket — they loop on the broadcast and run the identical
+        evaluation program, keeping every rank's jitted computations (and
+        their ICI collectives) in lockstep.  A ``None`` broadcast is the
+        shutdown sentinel, sent when the leader's loop exits for any reason.
         """
+        if self.multihost and not self._is_leader:
+            return self._work_follower()
         stop = stop_event or threading.Event()
         self._stop = threading.Event()
         self._jobs_done = 0  # each work() call gets a fresh budget
@@ -172,7 +198,25 @@ class GentunClient:
         finally:
             self._stop.set()
             self._close()
+            if self.multihost:
+                self._mh.broadcast_payload(None)  # release the followers
         return self._jobs_done
+
+    def _work_follower(self) -> int:
+        """Non-leader ranks: evaluate what the leader broadcasts, reply never.
+
+        The return value counts EVALUATIONS PERFORMED on this rank, which
+        can exceed the leader's completed-job count when a connection drop
+        makes the broker redeliver a batch (followers evaluate it twice,
+        the leader replies once).  ``max_jobs`` does not apply here — the
+        leader decides when the worker is done via the shutdown sentinel.
+        """
+        self._jobs_done = 0
+        while True:
+            jobs = self._mh.broadcast_payload(None)
+            if jobs is None:
+                return self._jobs_done
+            self._evaluate_batch(jobs)
 
     def _consume(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
@@ -184,7 +228,12 @@ class GentunClient:
             # as one vmapped program whatever the network latency was.
             # (Batches near the protocol size cap arrive split into several
             # frames, trained one frame per loop iteration — see protocol.py.)
-            self._evaluate_batch(self._await_jobs())
+            jobs = self._await_jobs()
+            if self.multihost:
+                # Ship the batch to every rank BEFORE evaluating: all
+                # processes must enter the same jitted programs together.
+                self._mh.broadcast_payload(jobs)
+            self._evaluate_batch(jobs)
 
     def _await_jobs(self) -> List[Dict[str, Any]]:
         while True:
@@ -247,9 +296,10 @@ class GentunClient:
             try:
                 pop.evaluate()
                 for job, ind in zip(ok_jobs, individuals):
-                    self._send({"type": "result", "job_id": job["job_id"], "fitness": ind.get_fitness()})
+                    if self._is_leader:
+                        self._send({"type": "result", "job_id": job["job_id"], "fitness": ind.get_fitness()})
+                        logger.info("job %s done: fitness %.6g", job["job_id"], ind.get_fitness())
                     self._jobs_done += 1
-                    logger.info("job %s done: fitness %.6g", job["job_id"], ind.get_fitness())
             except Exception as e:
                 # Evaluation is all-or-nothing per group: report every job so
                 # the broker can redeliver (ack-after-work semantics).
@@ -258,6 +308,8 @@ class GentunClient:
                     self._try_send_fail(job["job_id"], f"evaluate: {e!r}")
 
     def _try_send_fail(self, job_id: str, reason: str) -> None:
+        if not self._is_leader:
+            return  # follower ranks hold no connection; the leader reports
         try:
             self._send({"type": "fail", "job_id": job_id, "reason": reason[:2000]})
         except OSError:

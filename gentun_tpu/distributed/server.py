@@ -46,6 +46,19 @@ class DistributedPopulation(Population):
       wait forever, the reference's behavior).
     - ``broker``: share an existing started :class:`JobBroker` instead of
       owning one (used by :meth:`clone_with` across generations).
+    - ``evaluate_retries``: extra :meth:`evaluate` passes after a
+      ``JobFailed``/``GatherTimeout`` before giving up.  Each retry reships
+      ONLY the still-unevaluated individuals (finished fitnesses are
+      applied before the exception propagates internally), with fresh
+      broker attempt counts — so a transient worker glitch or straggler
+      timeout no longer kills a 50-generation search (the reference's
+      AMQP redelivers forever and never surfaces this).
+    - ``failed_policy``: what to do when retries are exhausted and some
+      individuals still lack fitness.  ``"raise"`` (default) re-raises —
+      today's loud behavior; ``"penalize"`` assigns them the worst
+      fitness observed in the generation (never cached — a penalty is not
+      a measurement) and lets the search continue, unless NOTHING
+      evaluated at all, which still raises.
     """
 
     def __init__(
@@ -68,7 +81,11 @@ class DistributedPopulation(Population):
         heartbeat_timeout: float = 15.0,
         broker: Optional[JobBroker] = None,
         fitness_cache: Optional[Dict[Any, float]] = None,
+        evaluate_retries: int = 0,
+        failed_policy: str = "raise",
     ):
+        if failed_policy not in ("raise", "penalize"):
+            raise ValueError(f"unknown failed_policy {failed_policy!r}")
         super().__init__(
             species,
             x_train=None,
@@ -84,6 +101,11 @@ class DistributedPopulation(Population):
             fitness_cache=fitness_cache,
         )
         self.job_timeout = job_timeout
+        self.evaluate_retries = int(evaluate_retries)
+        self.failed_policy = failed_policy
+        #: populated by every evaluate() call: {"attempts", "retries",
+        #: "penalized"} — the GA merges it into the generation history.
+        self.eval_stats: Dict[str, int] = {}
         if broker is not None:
             self.broker = broker
             self._owns_broker = False
@@ -116,8 +138,55 @@ class DistributedPopulation(Population):
     # -- the distributed fitness sweep ------------------------------------
 
     def evaluate(self) -> int:
-        """Publish one job per unevaluated individual; block for all replies.
-        Returns the number of jobs actually shipped (= trained remotely).
+        """Evaluate the population remotely, with bounded failure retries.
+
+        Returns the number of jobs that completed remotely across all
+        passes.  Each pass ships only still-unevaluated individuals, so a
+        retry after ``JobFailed``/``GatherTimeout`` re-trains exactly the
+        failed/unfinished work.  After ``evaluate_retries`` extra passes,
+        ``failed_policy`` decides: re-raise, or penalize the stragglers
+        with the generation's worst fitness and keep the search alive.
+        """
+        if not any(not ind.fitness_evaluated for ind in self.individuals):
+            # Nothing to do — and crucially, don't reset eval_stats: a
+            # follow-up no-op call (get_fittest() evaluates lazily) must not
+            # erase the real sweep's retry bookkeeping before the GA logs it.
+            return 0
+        stats = {"attempts": 0, "retries": 0, "penalized": 0}
+        self.eval_stats = stats
+        completed = 0
+        while True:
+            stats["attempts"] += 1
+            try:
+                return completed + self._evaluate_once()
+            except (JobFailed, GatherTimeout) as e:
+                completed += len(getattr(e, "partial", {}))
+                if stats["attempts"] <= self.evaluate_retries:
+                    stats["retries"] += 1
+                    logger.warning(
+                        "evaluate() pass %d/%d failed (%s); retrying the "
+                        "unfinished individuals",
+                        stats["attempts"], self.evaluate_retries + 1, e,
+                    )
+                    continue
+                evaluated = [i for i in self.individuals if i.fitness_evaluated]
+                if self.failed_policy == "penalize" and evaluated:
+                    fits = [i.get_fitness() for i in evaluated]
+                    worst = min(fits) if self.maximize else max(fits)
+                    for ind in self.individuals:
+                        if not ind.fitness_evaluated:
+                            ind.set_fitness(worst)  # deliberately NOT cached
+                            stats["penalized"] += 1
+                    logger.error(
+                        "evaluate() exhausted %d pass(es); penalized %d "
+                        "unfinished individual(s) with fitness %.6g (%s)",
+                        stats["attempts"], stats["penalized"], worst, e,
+                    )
+                    return completed
+                raise
+
+    def _evaluate_once(self) -> int:
+        """One ship-and-gather pass (no retry policy).
 
         This is the reference's population-level fitness override
         (SURVEY.md §3.2): genes out, fitness scalars back, barrier at the
@@ -206,6 +275,8 @@ class DistributedPopulation(Population):
             job_timeout=self.job_timeout,
             broker=self.broker,
             fitness_cache=self.fitness_cache,
+            evaluate_retries=self.evaluate_retries,
+            failed_policy=self.failed_policy,
         )
         # An embedded broker stays closeable through evolution: every clone
         # of an owning population co-owns it, so close() on whichever

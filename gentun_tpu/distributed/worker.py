@@ -12,6 +12,24 @@ it consumes jobs until killed:
 All model hyperparameters (``additional_parameters``) arrive from the
 master with each job, so the worker needs only its species and its copy of
 the training data — genes in, fitness out (SURVEY.md §1).
+
+Multi-host worker (ONE worker owning a whole TPU pod slice, e.g. a
+v5e-32 = 8 hosts × 4 chips — BASELINE config #4): run the same command on
+EVERY host of the slice, adding ``--coordinator <host0-ip>:8476``.  On TPU
+pods jax infers process count/ids from the pod metadata; on other clusters
+pass ``--num-processes 8 --process-id $RANK`` explicitly:
+
+    # on each TPU-VM host of the v5e-32 slice
+    python -m gentun_tpu.distributed.worker \
+        --host <master-ip> --password s3cret \
+        --species genetic-cnn --dataset cifar10 --capacity 32 \
+        --coordinator <host0-internal-ip>:8476
+
+Host 0 connects to the master and consumes jobs; the other hosts join its
+jitted computations over ICI (the job payloads are broadcast through the
+device fabric, never over a side channel).  The fitness mesh then spans
+all 32 chips automatically (``jax.devices()`` is global after
+``jax.distributed.initialize``).
 """
 
 from __future__ import annotations
@@ -61,9 +79,13 @@ def _load_dataset(name: str, data_dir=None, n=None):
 
 
 def _species(name: str):
-    from ..individuals import BoostingIndividual, GeneticCnnIndividual
+    from ..individuals import BoostingIndividual, GeneticCnnIndividual, XgboostIndividual
 
-    table = {"genetic-cnn": GeneticCnnIndividual, "boosting": BoostingIndividual}
+    table = {
+        "genetic-cnn": GeneticCnnIndividual,
+        "boosting": BoostingIndividual,
+        "xgboost": XgboostIndividual,  # reference 11-gene genome
+    }
     if name not in table:
         raise SystemExit(f"unknown species {name!r}; choose from {sorted(table)}")
     return table[name]
@@ -77,7 +99,7 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1", help="master broker host")
     ap.add_argument("--port", type=int, default=5672, help="master broker port")
     ap.add_argument("--password", default=None, help="broker shared token")
-    ap.add_argument("--species", default="genetic-cnn", help="genetic-cnn | boosting")
+    ap.add_argument("--species", default="genetic-cnn", help="genetic-cnn | boosting | xgboost")
     ap.add_argument("--dataset", default="mnist",
                     help="mnist | cifar10 | cifar100 | uci-wine | uci-binary")
     ap.add_argument("--data-dir", default=None,
@@ -87,6 +109,18 @@ def main(argv=None) -> int:
                     help="jobs taken at once; >1 trains the batch as one vmapped program")
     ap.add_argument("--worker-id", default=None)
     ap.add_argument("--max-jobs", type=int, default=None, help="exit after this many results")
+    mh = ap.add_argument_group(
+        "multi-host",
+        "run ONE logical worker across a multi-process jax cluster (e.g. all "
+        "hosts of a TPU pod slice).  Launch this command on EVERY host with "
+        "the same --coordinator; process 0 talks to the master, the rest "
+        "join its computations over ICI.  On TPU pods --num-processes/"
+        "--process-id may be omitted (inferred from pod metadata).",
+    )
+    mh.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="jax.distributed coordinator address (host 0)")
+    mh.add_argument("--num-processes", type=int, default=None)
+    mh.add_argument("--process-id", type=int, default=None)
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -94,6 +128,16 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if (args.num_processes is not None or args.process_id is not None) and args.coordinator is None:
+        raise SystemExit("--num-processes/--process-id require --coordinator")
+    multihost = args.coordinator is not None
+    if multihost:
+        # Must happen before ANY jax backend init (so before evaluation);
+        # after it, jax.devices() is the global pod-slice device list and
+        # the fitness mesh spans every host automatically.
+        from ..parallel import multihost as mh_mod
+
+        mh_mod.initialize(args.coordinator, args.num_processes, args.process_id)
     x, y, meta = _load_dataset(args.dataset, data_dir=args.data_dir, n=args.n)
     logging.getLogger("gentun_tpu.distributed").info(
         "worker data: %s (%d examples, synthetic=%s)", meta.get("source", args.dataset),
@@ -112,6 +156,7 @@ def main(argv=None) -> int:
         password=args.password,
         capacity=args.capacity,
         worker_id=args.worker_id,
+        multihost=multihost,
     )
     try:
         done = client.work(max_jobs=args.max_jobs)

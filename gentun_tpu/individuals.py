@@ -24,7 +24,7 @@ from typing import Any, Dict, Mapping, Optional, Type
 
 import numpy as np
 
-from .genes import GenomeSpec, boosting_genome, genetic_cnn_genome
+from .genes import GenomeSpec, boosting_genome, genetic_cnn_genome, xgboost_genome
 
 __all__ = ["Individual", "GeneticCnnIndividual", "BoostingIndividual", "XgboostIndividual"]
 
@@ -247,6 +247,11 @@ class BoostingIndividual(Individual):
 
     ``additional_parameters``: ``kfold`` (default 5), ``metric``
     (default "accuracy"), ``task`` ("classification" | "regression").
+
+    Backend selection: real xgboost (``models/xgboost.py`` — the
+    reference's ``xgb.cv``) whenever ``import xgboost`` succeeds, else the
+    sklearn translation (``models/boosting.py``).  Override with
+    ``model_cls``.
     """
 
     model_cls: Optional[Type] = None
@@ -262,13 +267,24 @@ class BoostingIndividual(Individual):
             )
         model_cls = self.model_cls
         if model_cls is None:
-            from .models.boosting import BoostingModel as model_cls
+            from .models import default_boosting_model
+
+            model_cls = default_boosting_model()
         model = model_cls(self.x_train, self.y_train, self.genes, **self.additional_parameters)
         return model.cross_validate()
 
 
-#: Alias for API-level parity with the reference's species name
-#: (``XgboostIndividual`` in ``gentun/individuals.py`` [PUB]).  The genome
-#: differs (sklearn-shaped, see :func:`gentun_tpu.genes.boosting_genome`)
-#: because xgboost is not installed; the search semantics are identical.
-XgboostIndividual = BoostingIndividual
+class XgboostIndividual(BoostingIndividual):
+    """The reference species, genome included (``gentun/individuals.py``
+    [PUB]; SURVEY.md §2.0 row 6): searches the 11 XGBoost hyperparameters
+    (eta, max_depth, min_child_weight, gamma, subsample,
+    colsample_bytree/bylevel, lambda, alpha, max_delta_step,
+    scale_pos_weight) with the reference's (default, min, max) bounds.
+
+    Backend follows :class:`BoostingIndividual`'s selection: real
+    ``xgb.cv`` when xgboost is importable (all 11 genes live — full
+    reference parity), sklearn translation otherwise (7 live, warned).
+    """
+
+    def build_spec(self, **params) -> GenomeSpec:
+        return xgboost_genome()

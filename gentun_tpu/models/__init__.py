@@ -1,13 +1,34 @@
 """Fitness models: the compute layer (SURVEY.md §2.0 rows 8-9).
 
 ``GentunModel`` is the ABC; ``GeneticCnnModel`` is the TPU hot path;
-``BoostingModel`` is the non-TPU control path (sklearn gradient boosting —
-xgboost is absent from this environment, SURVEY.md §2.1).
+the boosting control path has two interchangeable backends —
+``XgboostModel`` (the reference's exact ``xgb.cv`` semantics, used
+automatically whenever xgboost is importable) and ``BoostingModel``
+(sklearn gradient boosting, the fallback in this xgboost-less
+environment, SURVEY.md §2.1).  Both accept the same
+``additional_parameters``, so individuals and wire payloads are
+backend-agnostic.
 """
 
 from .generic import GentunModel
 
-__all__ = ["GentunModel"]
+__all__ = ["GentunModel", "default_boosting_model"]
+
+
+def default_boosting_model():
+    """The boosting fitness backend for this environment.
+
+    Fallback chain: real xgboost (``models/xgboost.py`` — all 11 reference
+    genes live) when importable, else the sklearn translation
+    (``models/boosting.py`` — 7 of 11 live, warned loudly).
+    """
+    from .xgboost import XgboostModel, xgboost_available
+
+    if xgboost_available():
+        return XgboostModel
+    from .boosting import BoostingModel
+
+    return BoostingModel
 
 try:  # jax/flax may be absent in minimal installs
     from .cnn import GeneticCnnModel, MaskedGeneticCnn  # noqa: F401

@@ -61,6 +61,7 @@ import optax
 
 from ..ops.dag import stack_genome_masks
 from ..parallel.mesh import auto_mesh, pad_population, shard_cv_args
+from ..parallel.multihost import fetch, place, place_tree
 from ..utils.jax_state import mark_backend_used
 from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
 from .generic import GentunModel
@@ -392,20 +393,24 @@ def _run_segmented(
     init_pop, train_pop, eval_pop = _fold_segment_fns(
         *_static_key(cfg, batch_size, n_train, n_val_padded, eval_batch_size)
     )
-    x_full, y_full = jnp.asarray(x_np), jnp.asarray(y_np)
     masks = stacked
     pop_s = batch_s = repl = None
     if mesh is not None:
+        # All placements go through parallel.multihost.place, which is
+        # plain device_put single-process and the multi-controller-legal
+        # make_array path when this worker spans several hosts.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         pop_s = NamedSharding(mesh, P("pop"))
         batch_s = NamedSharding(mesh, P(None, "data"))
         repl = NamedSharding(mesh, P())
         masks = [
-            {k: jax.device_put(v, pop_s) for k, v in stage.items()} for stage in stacked
+            {k: place(v, pop_s) for k, v in stage.items()} for stage in stacked
         ]
-        x_full = jax.device_put(x_full, repl)
-        y_full = jax.device_put(y_full, repl)
+        x_full = place(x_np, repl)
+        y_full = place(y_np, repl)
+    else:
+        x_full, y_full = jnp.asarray(x_np), jnp.asarray(y_np)
 
     kfold, total_steps = batch_idx.shape[0], batch_idx.shape[1]
     bounds = _segment_bounds(total_steps, cfg["segment_steps"])
@@ -414,18 +419,19 @@ def _run_segmented(
         p = jax.tree.map(lambda a: a[f], params)
         rng_f = fold_keys[f]
         if mesh is not None:
-            p = jax.device_put(p, pop_s)
-            rng_f = jax.device_put(rng_f, pop_s)
+            p = place_tree(p, pop_s)
+            rng_f = place(rng_f, pop_s)
         opt = init_pop(p)
         for s, e in bounds:
-            seg = jnp.asarray(batch_idx[f, s:e])
             if mesh is not None:
-                seg = jax.device_put(seg, batch_s)
+                seg = place(batch_idx[f, s:e], batch_s)
+            else:
+                seg = jnp.asarray(batch_idx[f, s:e])
             p, opt, rng_f = train_pop(p, opt, masks, x_full, y_full, seg, rng_f)
-        vi, vw = jnp.asarray(val_idx[f]), jnp.asarray(val_weight[f])
         if mesh is not None:
-            vi = jax.device_put(vi, repl)
-            vw = jax.device_put(vw, repl)
+            vi, vw = place(val_idx[f], repl), place(val_weight[f], repl)
+        else:
+            vi, vw = jnp.asarray(val_idx[f]), jnp.asarray(val_weight[f])
         # Keep the result ON device: materialising here would block the host
         # until fold f finishes and leave the device idle while the host
         # prepares fold f+1.  jax dispatch is async, so appending the device
@@ -433,7 +439,10 @@ def _run_segmented(
         # buffers still die at loop end (acc is tiny).
         accs.append(eval_pop(p, masks, x_full, y_full, vi, vw))
         del p, opt
-    return np.stack([np.asarray(a, np.float32) for a in accs])
+    # fetch = np.asarray single-process; an all-gather of the pop-sharded
+    # accuracies when the mesh spans processes (every host gets the full
+    # vector, keeping the SPMD ranks in lockstep).
+    return np.stack([fetch(a).astype(np.float32) for a in accs])
 
 
 @functools.lru_cache(maxsize=32)
@@ -492,7 +501,7 @@ def _content_fingerprint(a) -> Tuple[Any, ...]:
     return (arr.shape, str(arr.dtype), hash(sample.tobytes()))
 
 
-def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarray, cfg: Dict[str, Any]):
+def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarray, cfg: Dict[str, Any], mesh=None):
     """Device-resident permuted dataset, cached across evaluate() calls.
 
     Uploading the dataset dominates a warm proxy evaluation on a tunneled
@@ -521,6 +530,7 @@ def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarr
         int(cfg["seed"]),
         int(len(perm)),
         cfg["input_shape"],
+        mesh,  # Mesh hashes by devices+axes; None single-chip
     )
     hit = _DATASET_CACHE.get(key)
     if hit is not None:
@@ -538,7 +548,16 @@ def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarr
         if k[0] == key[0] and k[1] == key[1] and (k[2], k[3]) != (key[2], key[3])
     ]:
         del _DATASET_CACHE[k]
-    xd, yd = jnp.asarray(xp[perm]), jnp.asarray(yp[perm])
+    if mesh is not None:
+        # Cache the GLOBALLY-placed arrays: under a multi-process mesh a
+        # post-hoc re-placement would round-trip the whole dataset through
+        # the host every generation — the exact cost this cache kills.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        xd, yd = place(xp[perm], repl), place(yp[perm], repl)
+    else:
+        xd, yd = jnp.asarray(xp[perm]), jnp.asarray(yp[perm])
     try:
         xref, yref = weakref.ref(key_x), weakref.ref(key_y)
     except TypeError:
@@ -780,14 +799,14 @@ class GeneticCnnModel(GentunModel):
         if not cfg["fold_parallel"]:
             accs = _run_segmented(
                 cfg, stacked, params, fold_keys,
-                *_device_dataset(x_train, y_train, x, y, perm, cfg),
+                *_device_dataset(x_train, y_train, x, y, perm, cfg, mesh),
                 val_idx, val_weight, batch_idx, mesh, batch_size, n_tr,
                 n_val_padded, eval_bs,
             )
             return accs.mean(axis=0)[:n_real]
 
         fn = _population_cv_fn(*_static_key(cfg, batch_size, n_tr, n_val_padded, eval_bs))
-        x_dev, y_dev = _device_dataset(x_train, y_train, x, y, perm, cfg)
+        x_dev, y_dev = _device_dataset(x_train, y_train, x, y, perm, cfg, mesh)
         arrays = dict(
             x_full=x_dev,
             y_full=y_dev,
@@ -810,7 +829,7 @@ class GeneticCnnModel(GentunModel):
             arrays["batch_idx"],
             fold_keys,
         )
-        return np.asarray(acc, dtype=np.float32).mean(axis=0)[:n_real]
+        return fetch(acc).astype(np.float32).mean(axis=0)[:n_real]
 
 
     # -- final holdout evaluation (not part of the reference's API) --------

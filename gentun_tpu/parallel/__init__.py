@@ -1,10 +1,13 @@
-"""Multi-chip parallelism: mesh construction and input sharding.
+"""Multi-chip parallelism: mesh construction, input sharding, multi-host.
 
 SURVEY.md §2.2: the reference has population-task parallelism only; the
 rebuild adds per-worker data/population parallelism over a
-``jax.sharding.Mesh``, with XLA inserting all collectives (GSPMD).
+``jax.sharding.Mesh``, with XLA inserting all collectives (GSPMD), and
+multi-controller support so one worker can span a whole pod slice
+(``multihost.py`` — BASELINE config #4 "multi-host TPU-VM workers").
 """
 
+from . import multihost
 from .mesh import auto_mesh, mesh_axis_sizes, pad_population, shard_cv_args
 
-__all__ = ["auto_mesh", "mesh_axis_sizes", "pad_population", "shard_cv_args"]
+__all__ = ["auto_mesh", "mesh_axis_sizes", "pad_population", "shard_cv_args", "multihost"]

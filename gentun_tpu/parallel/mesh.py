@@ -33,6 +33,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .multihost import place, place_tree
+
 __all__ = ["auto_mesh", "pad_population", "shard_cv_args", "mesh_axis_sizes"]
 
 
@@ -126,14 +128,16 @@ def shard_cv_args(
     repl = NamedSharding(mesh, P())
     batch_spec = NamedSharding(mesh, P(None, None, "data"))
 
-    params = jax.device_put(params, fold_pop_spec)
+    # place/place_tree = device_put single-process; the multi-controller
+    # make_array path when the mesh spans several hosts (multihost.py).
+    params = place_tree(params, fold_pop_spec)
     masks_stacked = [
-        {k: jax.device_put(v, pop_spec) for k, v in stage.items()}
+        {k: place(v, pop_spec) for k, v in stage.items()}
         for stage in masks_stacked
     ]
-    fold_keys = jax.device_put(fold_keys, fold_pop_spec)
+    fold_keys = place(fold_keys, fold_pop_spec)
     out = dict(arrays)
     for name in ("x_full", "y_full", "val_idx", "val_weight"):
-        out[name] = jax.device_put(out[name], repl)
-    out["batch_idx"] = jax.device_put(out["batch_idx"], batch_spec)
+        out[name] = place(out[name], repl)
+    out["batch_idx"] = place(out["batch_idx"], batch_spec)
     return params, masks_stacked, fold_keys, out

@@ -106,8 +106,12 @@ def place(x: Any, sharding) -> jax.Array:
 
     Requires the host value to be identical on every process (deterministic
     pipelines guarantee this); each process contributes exactly its
-    addressable shards.
+    addressable shards.  An array already laid out as ``sharding`` passes
+    through untouched — callers can therefore re-place cached global arrays
+    (e.g. the device-resident dataset) every generation for free.
     """
+    if isinstance(x, jax.Array) and x.sharding.is_equivalent_to(sharding, x.ndim):
+        return x
     if jax.process_count() == 1:
         return jax.device_put(x, sharding)
     x = np.asarray(x)

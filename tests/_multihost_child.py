@@ -1,0 +1,123 @@
+"""Subprocess body for the multi-host tests (``test_multihost.py``).
+
+Each invocation is one process of a 2-process jax CPU cluster (4 virtual
+devices per process → 8 global).  The parent test sets JAX_PLATFORMS /
+XLA_FLAGS before spawning; this module initializes ``jax.distributed``,
+then either runs the sharded population CV (``cv`` mode, leader writes the
+accuracies to a JSON file for the parent to compare against its own
+single-process run) or drives a full multi-host worker against the
+parent's broker (``worker`` mode).
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def build_workload():
+    """The tiny deterministic CV workload shared by child and parent."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=64).astype(np.int32)
+    genomes = [
+        {
+            "S_1": tuple(int(b) for b in rng.integers(0, 2, 3)),
+            "S_2": tuple(int(b) for b in rng.integers(0, 2, 6)),
+            "S_3": tuple(int(b) for b in rng.integers(0, 2, 10)),
+        }
+        for _ in range(4)
+    ]
+    config = dict(
+        nodes=(3, 4, 5),
+        kernels_per_layer=(8, 8, 8),
+        kfold=2,
+        epochs=(1,),
+        learning_rate=(0.05,),
+        batch_size=16,
+        dense_units=16,
+        compute_dtype="float32",
+        seed=0,
+    )
+    return x, y, genomes, config
+
+
+def run_cv(mesh):
+    from gentun_tpu.models.cnn import GeneticCnnModel
+
+    x, y, genomes, config = build_workload()
+    return GeneticCnnModel.cross_validate_population(x, y, genomes, mesh=mesh, **config)
+
+
+class OneMax:
+    """Placeholder so ``worker`` mode can import a cheap species lazily."""
+
+
+def _one_max_species():
+    from gentun_tpu import Individual, genetic_cnn_genome
+
+    class _OneMax(Individual):
+        def build_spec(self, **params):
+            return genetic_cnn_genome((4, 4))
+
+        def evaluate(self):
+            return float(sum(sum(g) for g in self.genes.values()))
+
+    return _OneMax
+
+
+def main() -> None:
+    mode, pid, nproc, coord_port, out_path = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        int(sys.argv[4]),
+        sys.argv[5],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from gentun_tpu.parallel import multihost
+
+    multihost.initialize(f"127.0.0.1:{coord_port}", nproc, pid)
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 8, jax.device_count()
+
+    # Broadcast sanity on every run: the leader's object reaches all ranks
+    # through the device fabric.
+    obj = {"gen": 1, "payload": [1, 2, 3]} if multihost.is_leader() else None
+    got = multihost.broadcast_payload(obj)
+    assert got == {"gen": 1, "payload": [1, 2, 3]}, got
+
+    if mode == "cv":
+        from gentun_tpu.parallel.mesh import auto_mesh
+
+        mesh = auto_mesh(devices=jax.devices(), pop_axis=2, data_axis=4)
+        accs = run_cv(mesh)
+        if multihost.is_leader():
+            with open(out_path, "w") as f:
+                json.dump([float(a) for a in accs], f)
+    elif mode == "worker":
+        broker_port, max_jobs = int(sys.argv[6]), int(sys.argv[7])
+        from gentun_tpu.distributed import GentunClient
+
+        data = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+        client = GentunClient(
+            _one_max_species(),
+            *data,
+            host="127.0.0.1",
+            port=broker_port,
+            capacity=2,
+            heartbeat_interval=0.2,
+            reconnect_delay=0.1,
+            multihost=True,
+        )
+        done = client.work(max_jobs=max_jobs if multihost.is_leader() else None)
+        with open(out_path + f".rank{pid}", "w") as f:
+            json.dump({"rank": pid, "jobs_done": done}, f)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Multi-host (multi-controller) tests: one worker spanning processes.
+
+VERDICT r2 "do this" #1: the north-star topology is a v5e-32 — an 8-host
+slice owned by ONE worker.  No multi-host TPU exists in CI, so these tests
+form a real 2-process jax cluster over CPU (4 virtual devices per process,
+8 global — the same virtual-device mechanism as ``conftest.py``) and prove:
+
+- the sharded population CV runs under multi-controller execution and
+  matches the single-process result on the same logical mesh;
+- the leader/follower worker loop (process 0 owns the broker connection,
+  payload broadcast over the device fabric) completes real jobs end to end.
+
+The children run in subprocesses (``_multihost_child.py``) because a jax
+cluster needs one process per "host"; the parent uses its own in-process
+8-device CPU backend for the single-process reference run.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_cluster(mode: str, out_path: str, extra_args=(), nproc: int = 2):
+    """Launch an nproc-process jax CPU cluster of _multihost_child.py."""
+    coord_port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, mode, str(pid), str(nproc), str(coord_port), out_path,
+             *map(str, extra_args)],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for pid in range(nproc)
+    ]
+    return procs
+
+
+def _join(procs, timeout: float):
+    deadline = time.monotonic() + timeout
+    outs = []
+    for p in procs:
+        remaining = max(1.0, deadline - time.monotonic())
+        try:
+            out, _ = p.communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode(errors="replace"))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child rc={p.returncode}:\n{out[-3000:]}"
+    return outs
+
+
+def test_two_process_cluster_cv_matches_single_process(tmp_path):
+    """2 processes × 4 virtual CPU devices = one 8-device cluster running
+    the REAL sharded CV path; the leader's accuracies must match this
+    process's single-process run on the same logical (2, 4) mesh."""
+    sys.path.insert(0, os.path.dirname(CHILD))
+    try:
+        from _multihost_child import run_cv
+    finally:
+        sys.path.pop(0)
+    from gentun_tpu.parallel.mesh import auto_mesh
+
+    # Single-process reference on this process's 8 virtual devices
+    # (conftest.py pins JAX_PLATFORMS=cpu with 8 devices).
+    mesh = auto_mesh(pop_axis=2, data_axis=4)
+    assert mesh is not None, "test needs the 8-device conftest environment"
+    want = np.asarray(run_cv(mesh), dtype=np.float32)
+
+    out_path = str(tmp_path / "accs.json")
+    procs = _spawn_cluster("cv", out_path)
+    _join(procs, timeout=480.0)
+    with open(out_path) as f:
+        got = np.asarray(json.load(f), dtype=np.float32)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_multihost_worker_completes_jobs(tmp_path):
+    """Full leader/follower worker: process 0 consumes from the broker,
+    broadcasts batches over the device fabric, every rank evaluates, only
+    the leader replies — and the master's barrier completes."""
+    from gentun_tpu.distributed import JobBroker
+
+    rng = np.random.default_rng(7)
+    genomes = [
+        {"S_1": [int(b) for b in rng.integers(0, 2, 6)],
+         "S_2": [int(b) for b in rng.integers(0, 2, 6)]}
+        for _ in range(4)
+    ]
+    payloads = {
+        f"job-{i}": {"genes": g, "additional_parameters": {"nodes": (4, 4)}}
+        for i, g in enumerate(genomes)
+    }
+    broker = JobBroker(port=0).start()
+    procs = []
+    try:
+        _, port = broker.address
+        out_path = str(tmp_path / "worker.json")
+        procs = _spawn_cluster("worker", out_path, extra_args=(port, len(payloads)))
+        broker.submit(payloads)
+        results = broker.gather(list(payloads), timeout=300.0)
+        expected = {
+            f"job-{i}": float(sum(sum(g) for g in genomes[i].values()))
+            for i in range(len(genomes))
+        }
+        assert results == expected
+        _join(procs, timeout=120.0)
+        # Both ranks evaluated every job (lockstep), one rank replied.
+        with open(out_path + ".rank0") as f:
+            assert json.load(f)["jobs_done"] == len(payloads)
+        with open(out_path + ".rank1") as f:
+            assert json.load(f)["jobs_done"] == len(payloads)
+    finally:
+        for p in procs:  # never leak the cluster on a gather/assert failure
+            if p.poll() is None:
+                p.kill()
+        broker.stop()
