@@ -32,8 +32,13 @@ same JSON line:
   estimate is a lower bound).  Peak: 98.3e12 bf16 FLOP/s per TPU v5e chip
   (override with GENTUN_TPU_PEAK_FLOPS).
 - ``accuracy``: mean val accuracy on the prototype-separable synthetic data
-  for both configs, ASSERTED to beat 10-class chance by ≥2× (proxy) and
-  ≥4× (full schedule) — the bench fails loudly if the models stop learning.
+  for both configs, ASSERTED against regression bands set just under the
+  measured round-2 values (proxy 0.632 → gate 0.5; full 0.9911 → gate 0.9)
+  — a throughput win that halves accuracy now fails the bench instead of
+  passing a loose sanity check (VERDICT r2 item 7).
+- ``vs_prev_rounds``: throughput ratios and accuracy deltas against the
+  recorded BENCH_r{N}.json files, so a throughput-up/accuracy-down trade is
+  visible on the bench line itself.
 """
 
 import json
@@ -120,6 +125,53 @@ def schedule_flops(cfg: dict, pop: int) -> float:
     return pop * kfold * (train + evalf)
 
 
+def prev_round_deltas(record: dict, base_dir: str | None = None) -> dict:
+    """Throughput ratios / accuracy deltas vs each recorded BENCH_r{N}.json.
+
+    Makes a throughput-up-accuracy-down trade visible on the bench line
+    itself instead of requiring a manual diff of round artifacts.
+    ``base_dir`` overrides where the artifacts are looked up (tests).
+    """
+    here = base_dir or os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    for n in range(1, 100):
+        path = os.path.join(here, f"BENCH_r{n:02d}.json")
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                prev = json.load(f).get("parsed") or {}
+            entry = {}
+            if prev.get("value"):
+                entry["throughput_ratio"] = round(record["value"] / prev["value"], 3)
+            prev_acc = (prev.get("accuracy") or {}).get("proxy_mean")
+            if prev_acc is not None:
+                entry["proxy_accuracy_delta"] = round(
+                    record["accuracy"]["proxy_mean"] - prev_acc, 4
+                )
+            prev_full = prev.get("full_schedule") or {}
+            cur_full = record.get("full_schedule") or {}
+            if prev_full.get("individuals_per_hour_per_chip") and cur_full.get(
+                "individuals_per_hour_per_chip"
+            ):
+                entry["full_throughput_ratio"] = round(
+                    cur_full["individuals_per_hour_per_chip"]
+                    / prev_full["individuals_per_hour_per_chip"],
+                    3,
+                )
+            if prev_full.get("accuracy_mean") is not None and cur_full.get(
+                "accuracy_mean"
+            ) is not None:
+                entry["full_accuracy_delta"] = round(
+                    cur_full["accuracy_mean"] - prev_full["accuracy_mean"], 4
+                )
+            if entry:
+                out[f"r{n:02d}"] = entry
+        except (OSError, ValueError, KeyError):  # a malformed artifact never kills the bench
+            continue
+    return out
+
+
 def timed_run(x, y, cfg: dict, pop: int):
     from gentun_tpu.models.cnn import GeneticCnnModel
 
@@ -147,9 +199,13 @@ def main() -> None:
     value = POP / proxy_s * 3600.0 / n_chips
     assert np.isfinite(proxy_accs).all()
     chance = 1.0 / N_CLASSES
-    assert proxy_accs.mean() > 2 * chance, (
-        f"proxy accuracy {proxy_accs.mean():.3f} does not beat 2x chance — "
-        "the benchmarked model is not learning"
+    # Regression band, not a sanity floor: round 2 measured 0.632 mean
+    # proxy accuracy on this fixed workload; 0.5 is ~20% headroom for
+    # run-to-run noise while still failing on any real learning regression.
+    assert proxy_accs.mean() > 0.5, (
+        f"proxy accuracy {proxy_accs.mean():.3f} regressed below the 0.5 gate "
+        "(round-2 measured 0.632) — throughput is meaningless if the model "
+        "stopped learning"
     )
 
     record = {
@@ -173,8 +229,10 @@ def main() -> None:
             full_rate = POP / full_s * 3600.0 / n_chips
             mfu = schedule_flops(FULL, POP) / full_s / (PEAK_FLOPS * n_chips)
             assert np.isfinite(full_accs).all()
-            assert full_accs.mean() > 4 * chance, (
-                f"full-schedule accuracy {full_accs.mean():.3f} does not beat 4x chance"
+            # Round 2 measured 0.9911 at this schedule; 0.9 is the band.
+            assert full_accs.mean() > 0.9, (
+                f"full-schedule accuracy {full_accs.mean():.3f} regressed below "
+                "the 0.9 gate (round-2 measured 0.9911)"
             )
             record["full_schedule"] = {
                 "individuals_per_hour_per_chip": round(full_rate, 2),
@@ -191,6 +249,9 @@ def main() -> None:
         except Exception as e:  # loud but non-fatal: the proxy metric survives
             record["full_schedule"] = {"error": f"{type(e).__name__}: {e}"}
 
+    deltas = prev_round_deltas(record)
+    if deltas:
+        record["vs_prev_rounds"] = deltas
     print(json.dumps(record))
 
 

@@ -17,6 +17,12 @@ Here the broker is embedded in the master, so there are only two roles:
 ``--capacity 8`` lets one worker take 8 individuals at a time and train
 them as a single vmapped TPU program — the batched equivalent of the
 reference's one-individual-per-chip model.
+
+For a worker spanning a whole multi-host pod slice (v5e-32 and friends),
+use the installable worker CLI with ``--coordinator`` on every host of
+the slice (see ``python -m gentun_tpu.distributed.worker --help`` and
+README "Multi-host workers") — process 0 joins this master, the other
+hosts join process 0 over ICI.
 """
 
 import argparse
@@ -52,6 +58,10 @@ def run_master(args):
         host="0.0.0.0",
         port=args.port,
         password=args.password or None,
+        # Production posture for long searches: a transient worker failure
+        # or straggler timeout re-ships only the unfinished individuals
+        # instead of killing the run (see README "Distributed search").
+        evaluate_retries=3,
     ) as pop:
         print(f"broker listening on port {pop.broker_address[1]}; waiting for workers")
         best = GeneticAlgorithm(pop, seed=0).run(args.generations)

@@ -662,8 +662,10 @@ class GeneticCnnModel(GentunModel):
     device call in the default segmented executor (None = one call per
     fold); ``fold_parallel=True`` switches to the fused single-program
     vmap-folds path; ``stage_exit_conv`` adds the Xie & Yuille output-node
-    conv; ``mesh``/``cache_dir`` control sharding and the persistent
-    compilation cache.
+    conv — measured at the full schedule on two workloads, the bare-sum
+    default matched or beat it on CV and holdout accuracy, so False stays
+    the default (docs/STAGE_EXIT_CONV.md has the table); ``mesh``/
+    ``cache_dir`` control sharding and the persistent compilation cache.
 
     Data contract: ``x_train``/``y_train`` are treated as immutable — the
     permuted dataset is cached on device across ``evaluate()`` calls, keyed

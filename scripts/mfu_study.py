@@ -1,0 +1,168 @@
+"""Where does the non-MXU time go?  (VERDICT r2 "do this" #8.)
+
+Round 2 measured 0.54 analytic MFU for the full-schedule bench workload
+and left "the other 46%" unexplained.  This script decomposes one
+``cross_validate_population`` call at the bench's full schedule into its
+actual phases — setup/indices (host), parameter init, per-segment train
+execution, and eval — with ``block_until_ready`` fences at phase
+boundaries, computes the train-phase-only MFU (the number the analytic
+model can fairly be compared to), and captures a ``jax.profiler`` trace
+of a steady-state segment window for the record.
+
+The phase replication below mirrors ``GeneticCnnModel.cross_validate_population``
+(models/cnn.py) step by step on purpose: the study needs fences BETWEEN
+phases that the production path deliberately fuses/pipelines.
+
+Writes its findings into PERF.md (## MFU accounting section) and the raw
+numbers to scripts/mfu_study.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bench  # noqa: E402  (the bench workload IS the subject)
+from gentun_tpu.models import cnn as M  # noqa: E402
+
+
+def decompose(cfg_overrides=None, pop=bench.POP, trace_dir=None):
+    x, y = bench.synthetic_cifar(bench.N_DATA)
+    genomes = bench.random_population(pop, seed=2)
+    config = dict(bench.FULL, **(cfg_overrides or {}))
+
+    t_all0 = time.time()
+    phases = {}
+
+    # -- phase 1: config/data prep + mesh/mask setup (host + tiny uploads)
+    t0 = time.time()
+    cfg = M._normalize_config(x, y, dict(config))
+    xp, yp = M._prepare_data(x, y, cfg)
+    mesh, genomes_p, n_real, pop_p, stacked, model = M._prepare_population_setup(cfg, genomes)
+    kfold = cfg["kfold"]
+    n = xp.shape[0]
+    fold_size = n // kfold
+    n_use = fold_size * kfold
+    rng = np.random.default_rng(cfg["seed"])
+    perm = rng.permutation(n)[:n_use]
+    folds = np.arange(n_use, dtype=np.int32).reshape(kfold, fold_size)
+    batch_size = min(cfg["batch_size"], n_use - fold_size)
+    n_tr = n_use - fold_size
+    steps_per_epoch = max(n_tr // batch_size, 1)
+    total_steps = sum(cfg["epochs"]) * steps_per_epoch
+    eval_bs, n_val_padded = M._eval_batch_size(batch_size, fold_size)
+    pad = n_val_padded - fold_size
+    batch_idx = np.zeros((kfold, total_steps, batch_size), dtype=np.int32)
+    val_idx = np.zeros((kfold, n_val_padded), dtype=np.int32)
+    val_weight = np.zeros((kfold, n_val_padded), dtype=np.float32)
+    for f in range(kfold):
+        tr_idx = np.concatenate([folds[g] for g in range(kfold) if g != f])
+        order = np.concatenate(
+            [rng.permutation(n_tr) for _ in range(sum(cfg["epochs"]))]
+        )[: total_steps * batch_size]
+        batch_idx[f] = tr_idx[order].reshape(total_steps, batch_size)
+        val_idx[f] = np.concatenate([folds[f], np.full(pad, folds[f][0])])
+        val_weight[f] = np.concatenate(
+            [np.ones(fold_size, np.float32), np.zeros(pad, np.float32)]
+        )
+    phases["host_setup_and_indices"] = time.time() - t0
+
+    # -- phase 2: dataset upload (cache cleared to measure the cold cost;
+    #    a real search pays this once, then hits the device cache)
+    t0 = time.time()
+    M._DATASET_CACHE.clear()
+    x_dev, y_dev = M._device_dataset(x, y, xp, yp, perm, cfg, mesh)
+    jax.block_until_ready((x_dev, y_dev))
+    phases["dataset_upload_cold"] = time.time() - t0
+
+    # -- phase 3: parameter init (jitted, fold x pop vmapped)
+    t0 = time.time()
+    params = M._init_population_params(
+        model, stacked, cfg["input_shape"], pop_p, kfold, cfg["seed"]
+    )
+    jax.block_until_ready(params)
+    phases["param_init"] = time.time() - t0
+
+    base_key = jax.random.PRNGKey(cfg["seed"])
+    fold_keys = jnp.stack(
+        [jax.random.split(jax.random.fold_in(base_key, f), pop_p) for f in range(kfold)]
+    )
+
+    # -- phase 4/5: the segmented executor, fenced per phase
+    init_pop, train_pop, eval_pop = M._fold_segment_fns(
+        *M._static_key(cfg, batch_size, n_tr, n_val_padded, eval_bs)
+    )
+    bounds = M._segment_bounds(total_steps, cfg["segment_steps"])
+    t_train = t_eval = t_dispatch = 0.0
+    accs = []
+    traced = False
+    for f in range(kfold):
+        p = jax.tree.map(lambda a: a[f], params)
+        rng_f = fold_keys[f]
+        opt = init_pop(p)
+        jax.block_until_ready(opt)
+        for si, (s, e) in enumerate(bounds):
+            if trace_dir and not traced and f == 1 and si == 2:
+                # steady state: fold 1, third segment window
+                jax.profiler.start_trace(trace_dir)
+            t0 = time.time()
+            seg = jnp.asarray(batch_idx[f, s:e])
+            t_dispatch += time.time() - t0
+            t0 = time.time()
+            p, opt, rng_f = train_pop(p, opt, stacked, x_dev, y_dev, seg, rng_f)
+            jax.block_until_ready(p)
+            t_train += time.time() - t0
+            if trace_dir and not traced and f == 1 and si == 3:
+                jax.profiler.stop_trace()
+                traced = True
+        t0 = time.time()
+        vi, vw = jnp.asarray(val_idx[f]), jnp.asarray(val_weight[f])
+        a = eval_pop(p, stacked, x_dev, y_dev, vi, vw)
+        jax.block_until_ready(a)
+        t_eval += time.time() - t0
+        accs.append(np.asarray(a, np.float32))
+    phases["train_segments"] = t_train
+    phases["eval"] = t_eval
+    phases["segment_index_upload"] = t_dispatch
+    phases["total_fenced"] = time.time() - t_all0
+
+    # analytic FLOPs, split train vs eval like bench.schedule_flops; peak
+    # scales with the chips the auto-mesh spreads the pop axis over
+    n_chips = jax.local_device_count()
+    fwd = bench.forward_flops_per_image()
+    train_flops = pop_p * kfold * total_steps * batch_size * 3.0 * fwd
+    eval_flops = pop_p * kfold * n_val_padded * fwd
+    peak = bench.PEAK_FLOPS * n_chips
+    phases["mfu_train_only"] = train_flops / t_train / peak
+    phases["mfu_overall_fenced"] = (train_flops + eval_flops) / phases["total_fenced"] / peak
+    phases["accs_mean"] = float(np.mean([a.mean() for a in accs]))
+    return phases
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace_dir = os.path.join(repo, "scripts", "mfu_trace")
+    # warmup: compile everything once so the decomposition measures steady state
+    print("warmup (compile)...", flush=True)
+    decompose()
+    print("measuring (fenced)...", flush=True)
+    phases = decompose(trace_dir=trace_dir)
+    for k, v in phases.items():
+        print(f"  {k}: {v:.4f}" if isinstance(v, float) else f"  {k}: {v}", flush=True)
+    with open(os.path.join(repo, "scripts", "mfu_study.json"), "w") as f:
+        json.dump({k: round(float(v), 5) for k, v in phases.items()}, f, indent=1)
+    print(f"trace: {trace_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

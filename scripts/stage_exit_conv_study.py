@@ -1,0 +1,150 @@
+"""Settle ``stage_exit_conv`` against the paper, with measurements.
+
+VERDICT r2 "do this" #5.  Xie & Yuille (Genetic CNN, ICCV 2017) apply a
+Conv+ReLU at each stage's default OUTPUT node after summing its inputs;
+rounds 1-2 of this rebuild defaulted to a bare sum (``stage_exit_conv=
+False``) "to preserve round-1 behavior".  This script measures both
+variants at the reference-default schedule on two workloads:
+
+- real handwritten digits (sklearn ``load_digits`` upscaled, the MNIST
+  stand-in) at reference S=(3,5) / kernels (20,50);
+- synthetic CIFAR-10-shaped data at S=(3,4,5) / kernels (32,64,128) — the
+  bench workload.
+
+For each variant: mean CV fitness over a shared random population, a
+holdout accuracy of the best genome, and wall time (the exit conv adds
+parameters and FLOPs, so throughput is part of the decision).  Writes a
+markdown table to ``docs/STAGE_EXIT_CONV.md``; the committed default in
+``models/cnn.py`` cites that table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synthetic_cifar  # noqa: E402  (the bench workload's generator)
+from gentun_tpu.genes import genetic_cnn_genome  # noqa: E402
+from gentun_tpu.models.cnn import GeneticCnnModel  # noqa: E402
+from gentun_tpu.utils.datasets import load_mnist  # noqa: E402
+
+FULL_SCHEDULE = dict(kfold=5, epochs=(20, 4, 1), learning_rate=(1e-2, 1e-3, 1e-4))
+
+
+def workloads():
+    x, y, meta = load_mnist(n=1400, seed=7)
+    yield (
+        "digits (real)",
+        dict(
+            nodes=(3, 5), kernels_per_layer=(20, 50), dense_units=500,
+            batch_size=128, seed=0, **FULL_SCHEDULE,
+        ),
+        (x[:1000], y[:1000], x[1000:], y[1000:]),
+    )
+    xc, yc = synthetic_cifar(6000)
+    yield (
+        "synthetic CIFAR-10",
+        dict(
+            nodes=(3, 4, 5), kernels_per_layer=(32, 64, 128), dense_units=256,
+            batch_size=256, compute_dtype="bfloat16", seed=0, **FULL_SCHEDULE,
+        ),
+        (xc[:5000], yc[:5000], xc[5000:], yc[5000:]),
+    )
+
+
+def main() -> int:
+    pop = int(os.environ.get("STUDY_POP", 8))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows, raw = [], {}
+    for name, params, (x, y, x_te, y_te) in workloads():
+        rng = np.random.default_rng(5)
+        spec = genetic_cnn_genome(tuple(params["nodes"]))
+        genomes = [spec.sample(rng) for _ in range(pop)]
+        for variant in (False, True):
+            cfg = dict(params, stage_exit_conv=variant)
+            t0 = time.time()
+            accs = np.asarray(
+                GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
+            )
+            wall = time.time() - t0
+            best = genomes[int(np.argmax(accs))]
+            held = float(
+                GeneticCnnModel.train_and_score(x, y, x_te, y_te, [best], **cfg)[0]
+            )
+            rows.append((name, variant, accs, held, wall))
+            raw[f"{name}|exit_conv={variant}"] = {
+                "cv_accs": [round(float(a), 4) for a in accs],
+                "holdout_best": round(held, 4),
+                "wall_s": round(wall, 1),
+            }
+            print(
+                f"[{name} exit_conv={variant}] cv_mean={accs.mean():.4f} "
+                f"cv_best={accs.max():.4f} holdout={held:.4f} wall={wall:.0f}s",
+                flush=True,
+            )
+
+    out = os.path.join(repo, "docs", "STAGE_EXIT_CONV.md")
+    lines = [
+        "# stage_exit_conv: measured decision",
+        "",
+        "Xie & Yuille apply Conv+ReLU after the default output node's sum;",
+        "earlier rounds defaulted to a bare sum.  Both variants at the",
+        f"reference-default schedule (kfold=5, epochs=(20,4,1)), {pop} shared",
+        "random genomes per workload (`python scripts/stage_exit_conv_study.py`,",
+        "one TPU v5e chip):",
+        "",
+        "| workload | exit conv | CV mean | CV best | holdout (best genome) | wall s |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, variant, accs, held, wall in rows:
+        lines.append(
+            f"| {name} | {'ON (paper)' if variant else 'off (sum only)'} | "
+            f"{accs.mean():.4f} | {accs.max():.4f} | {held:.4f} | {wall:.0f} |"
+        )
+    by_variant = {}
+    for _, variant, accs, held, _ in rows:
+        by_variant.setdefault(variant, []).append((float(accs.mean()), held))
+    on_better_cv = all(
+        on[0] >= off[0] - 0.005
+        for on, off in zip(by_variant[True], by_variant[False])
+    )
+    lines += [
+        "",
+        "Wall seconds include each variant's one-off XLA compiles (the two",
+        "variants are different programs), so CV/holdout accuracy — not the",
+        "wall column — is the decision basis; per-genome FLOPs differ by",
+        "only the one extra conv per stage.",
+        "",
+        "## Decision",
+        "",
+    ]
+    if on_better_cv:
+        lines.append(
+            "The paper-faithful variant matches or beats the bare sum on CV "
+            "accuracy on both workloads — this measurement supports making "
+            "`stage_exit_conv=True` the default; update `models/cnn.py` "
+            "accordingly (the doc describes the data, the code holds the "
+            "default)."
+        )
+    else:
+        lines.append(
+            "The bare sum measured better on at least one workload; the "
+            "default stays **False** with the paper variant one knob away. "
+            "(Numbers above are the evidence.)"
+        )
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(os.path.join(repo, "scripts", "stage_exit_conv_study.json"), "w") as f:
+        json.dump(raw, f, indent=1)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
