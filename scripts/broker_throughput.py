@@ -1,0 +1,86 @@
+"""Control-plane throughput: how many fitness jobs/sec can the broker move?
+
+The data plane's measured ceiling is ~22k proxy evaluations/hour/chip
+≈ 6.2 jobs/sec *per chip* (bench.py).  This micro-benchmark measures the
+master-side ceiling — the embedded asyncio TCP/JSON broker moving
+genes-out/fitness-back round trips through real sockets against real
+``GentunClient`` workers running trivial evaluations — so the "broker
+feeds N chips" claim in the docs is a measured number, not a hope.
+
+CPU-only, a few seconds: `python scripts/broker_throughput.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gentun_tpu import Individual, genetic_cnn_genome  # noqa: E402
+from gentun_tpu.distributed import GentunClient, JobBroker  # noqa: E402
+
+
+class NoopIndividual(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome((4, 4))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
+    data = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+    rng = np.random.default_rng(0)
+    payloads = {
+        f"j{i}": {
+            "genes": {
+                "S_1": [int(b) for b in rng.integers(0, 2, 6)],
+                "S_2": [int(b) for b in rng.integers(0, 2, 6)],
+            },
+            "additional_parameters": {"nodes": (4, 4)},
+        }
+        for i in range(n_jobs)
+    }
+    broker = JobBroker(port=0).start()
+    stop = threading.Event()
+    threads = []
+    try:
+        _, port = broker.address
+        for _ in range(n_workers):
+            t = threading.Thread(
+                target=lambda: GentunClient(
+                    NoopIndividual, *data, port=port, capacity=capacity,
+                    heartbeat_interval=1.0, reconnect_delay=0.1,
+                ).work(stop_event=stop),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        t0 = time.monotonic()
+        broker.submit(payloads)
+        results = broker.gather(list(payloads), timeout=120.0)
+        wall = time.monotonic() - t0
+        assert len(results) == n_jobs
+        return {
+            "n_jobs": n_jobs,
+            "n_workers": n_workers,
+            "capacity": capacity,
+            "wall_s": round(wall, 3),
+            "jobs_per_sec": round(n_jobs / wall, 1),
+            # one chip consumes ~6.2 proxy jobs/sec (bench.py ≈22.2k/hour)
+            "chips_fed_at_proxy_rate": int(n_jobs / wall / 6.2),
+        }
+    finally:
+        stop.set()
+        broker.stop()
+
+
+if __name__ == "__main__":
+    out = run()
+    print(json.dumps(out))

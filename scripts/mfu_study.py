@@ -102,6 +102,11 @@ def decompose(cfg_overrides=None, pop=bench.POP, trace_dir=None):
         *M._static_key(cfg, batch_size, n_tr, n_val_padded, eval_bs)
     )
     bounds = M._segment_bounds(total_steps, cfg["segment_steps"])
+    # Steady-state trace window: one segment, safely inside the bounds
+    # list whatever its length (schedules with a single segment get the
+    # only one there is; start/stop always pair up).
+    trace_fold = min(1, kfold - 1)
+    trace_start = max(0, min(2, len(bounds) - 1))
     t_train = t_eval = t_dispatch = 0.0
     accs = []
     traced = False
@@ -111,8 +116,8 @@ def decompose(cfg_overrides=None, pop=bench.POP, trace_dir=None):
         opt = init_pop(p)
         jax.block_until_ready(opt)
         for si, (s, e) in enumerate(bounds):
-            if trace_dir and not traced and f == 1 and si == 2:
-                # steady state: fold 1, third segment window
+            tracing_now = trace_dir and not traced and f == trace_fold and si == trace_start
+            if tracing_now:
                 jax.profiler.start_trace(trace_dir)
             t0 = time.time()
             seg = jnp.asarray(batch_idx[f, s:e])
@@ -121,7 +126,7 @@ def decompose(cfg_overrides=None, pop=bench.POP, trace_dir=None):
             p, opt, rng_f = train_pop(p, opt, stacked, x_dev, y_dev, seg, rng_f)
             jax.block_until_ready(p)
             t_train += time.time() - t0
-            if trace_dir and not traced and f == 1 and si == 3:
+            if tracing_now:
                 jax.profiler.stop_trace()
                 traced = True
         t0 = time.time()

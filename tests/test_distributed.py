@@ -637,6 +637,19 @@ class TestBrokerEdgeCases:
         finally:
             broker.stop()
 
+    def test_exact_max_size_frame_round_trips(self):
+        """A payload of exactly MAX_MESSAGE_BYTES passes encode() and must
+        survive decode() too — the framing newline no longer tips the frame
+        over the size check (ADVICE r3 boundary fix)."""
+        from gentun_tpu.distributed.protocol import MAX_MESSAGE_BYTES, decode, encode
+
+        probe = {"type": "result", "job_id": "j", "fitness": 1.0, "pad": ""}
+        overhead = len(encode(probe)) - 1  # minus the newline
+        probe["pad"] = "x" * (MAX_MESSAGE_BYTES - overhead)
+        frame = encode(probe)
+        assert len(frame) == MAX_MESSAGE_BYTES + 1  # payload + newline
+        assert decode(frame)["pad"] == probe["pad"]
+
     def test_large_batch_splits_into_multiple_frames_and_completes(self):
         """Batches over the soft cap arrive as several `jobs` frames; a real
         worker consumes them frame by frame and every job completes."""
