@@ -30,6 +30,14 @@ jitted computations over ICI (the job payloads are broadcast through the
 device fabric, never over a side channel).  The fitness mesh then spans
 all 32 chips automatically (``jax.devices()`` is global after
 ``jax.distributed.initialize``).
+
+Operator note: the follower ranks exit when the leader's loop ends (a
+shutdown sentinel rides the last broadcast).  If the LEADER process is
+killed outright (no chance to send the sentinel), followers block in the
+broadcast collective until the jax distributed runtime times the
+collective out and aborts them — restart the worker command on all hosts
+of the slice together, like any SPMD job.  The master side needs no
+action either way: unacked jobs redeliver to other workers.
 """
 
 from __future__ import annotations

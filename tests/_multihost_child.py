@@ -1,8 +1,9 @@
 """Subprocess body for the multi-host tests (``test_multihost.py``).
 
-Each invocation is one process of a 2-process jax CPU cluster (4 virtual
-devices per process → 8 global).  The parent test sets JAX_PLATFORMS /
-XLA_FLAGS before spawning; this module initializes ``jax.distributed``,
+Each invocation is one process of an N-process jax CPU cluster (8/N
+virtual devices per process → always 8 global).  The parent test sets
+JAX_PLATFORMS / XLA_FLAGS before spawning; this module initializes
+``jax.distributed``,
 then either runs the sharded population CV (``cv`` mode, leader writes the
 accuracies to a JSON file for the parent to compare against its own
 single-process run) or drives a full multi-host worker against the
