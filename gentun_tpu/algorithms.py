@@ -26,17 +26,11 @@ import numpy as np
 
 from .individuals import Individual
 from .populations import Population
+from .utils.fitness_store import is_serializable_key, tuplify
 
 __all__ = ["GeneticAlgorithm", "RussianRouletteGA"]
 
 logger = logging.getLogger("gentun_tpu")
-
-
-def _tuplify(obj: Any) -> Any:
-    """Inverse of JSON's tuple→list coercion for fitness-cache keys."""
-    if isinstance(obj, list):
-        return tuple(_tuplify(v) for v in obj)
-    return obj
 
 
 def _initialized_chip_count() -> int:
@@ -176,19 +170,17 @@ class GeneticAlgorithm:
     # -- (de)serialization state for checkpoint/resume ---------------------
 
     def state_dict(self) -> Dict[str, Any]:
-        # Fitness-cache keys are nested tuples, usually of JSON-native leaves
-        # (Individual.cache_key); JSON turns tuples into lists and _tuplify()
-        # reverses that exactly on load.  Keys that embed non-JSON values
-        # (bytes from ndarray params, arbitrary objects) are skipped — the
+        # Fitness-cache keys are nested tuples, usually of JSON-native
+        # leaves (Individual.cache_key); JSON turns tuples into lists and
+        # tuplify() reverses that exactly on load (the shared convention —
+        # utils/fitness_store.py).  Unserializable keys are skipped: the
         # checkpoint must never crash the search over a cache entry, and a
         # dropped entry only costs a retrain after resume.
-        fitness_cache = []
-        for k, v in self.population.fitness_cache.items():
-            try:
-                json.dumps(k)
-            except (TypeError, ValueError):
-                continue
-            fitness_cache.append([k, v])
+        fitness_cache = [
+            [k, v]
+            for k, v in self.population.fitness_cache.items()
+            if is_serializable_key(k)
+        ]
         return {
             "algorithm": type(self).__name__,
             "fitness_cache": fitness_cache,
@@ -234,7 +226,7 @@ class GeneticAlgorithm:
             individuals.append(ind)
         self.population.individuals = individuals
         self.population.fitness_cache = {
-            _tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
+            tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
         }
 
 

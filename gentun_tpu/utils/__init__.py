@@ -1,13 +1,17 @@
 """Auxiliary subsystems the reference lacks (SURVEY.md §5): checkpoint /
-resume, offline-safe dataset loaders, tracing/metrics."""
+resume, cross-run fitness persistence, offline-safe dataset loaders,
+tracing/metrics."""
 
 from .checkpoint import Checkpointer, load_checkpoint
+from .fitness_store import load_fitness_cache, save_fitness_cache
 from .profiling import EvalTimer, trace
 from .xla_cache import default_cache_dir, enable_compilation_cache
 
 __all__ = [
     "Checkpointer",
     "load_checkpoint",
+    "load_fitness_cache",
+    "save_fitness_cache",
     "EvalTimer",
     "trace",
     "enable_compilation_cache",

@@ -1,0 +1,136 @@
+"""Cross-run fitness persistence: never train the same architecture twice,
+even across separate searches.
+
+``Population.fitness_cache`` already spans generations within one search
+and rides checkpoints within one resumed search (``utils/checkpoint.py``).
+This module extends the reuse across PROCESSES and EXPERIMENTS, the same
+way ``utils/xla_cache.py`` persists compilations: a plain JSON file of
+``[cache_key, fitness]`` pairs that any number of runs can load, extend,
+and merge.  The reference has no counterpart (its only reuse is in-memory
+``get_fitness`` caching [PUB]); repeated experimentation — exactly the
+workload a hyperparameter-search tool exists for — retrains everything.
+
+Keys are ``Individual.cache_key()`` values (nested tuples of JSON-native
+leaves; architecture-canonical for ``GeneticCnnIndividual``), serialized
+with the checkpoint's tuple↔list convention.  Keys that embed non-JSON
+values are skipped on save, like the checkpoint does — a dropped entry
+only costs a retrain.
+
+Usage::
+
+    cache = load_fitness_cache("digits_s35.fitness.json")   # {} if absent
+    pop = Population(GeneticCnnIndividual, ..., fitness_cache=cache)
+    GeneticAlgorithm(pop, seed=0).run(50)
+    save_fitness_cache(pop.fitness_cache, "digits_s35.fitness.json")
+
+``save_fitness_cache`` MERGES with whatever is already in the file (other
+runs may have written since we loaded), and writes atomically.
+
+The cache key embeds ``additional_parameters``, so entries are only ever
+reused for identical training configurations; a changed schedule or
+dataset size produces disjoint keys.  Changed dataset CONTENT under the
+same configuration is the caller's responsibility, exactly as with the
+reference's in-memory cache — keep one file per dataset.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+from typing import Any, Dict
+
+__all__ = ["load_fitness_cache", "save_fitness_cache", "tuplify", "is_serializable_key"]
+
+
+def tuplify(obj: Any) -> Any:
+    """Inverse of JSON's tuple→list coercion.
+
+    THE canonical definition of the cache-key serialization convention —
+    the checkpoint (``algorithms.state_dict``) and this store share it, so
+    a cache saved by either subsystem round-trips through the other.
+    """
+    if isinstance(obj, list):
+        return tuple(tuplify(v) for v in obj)
+    return obj
+
+
+def is_serializable_key(key: Any) -> bool:
+    """True when a cache key survives the JSON round trip.
+
+    Keys that embed non-JSON values (bytes from ndarray params, arbitrary
+    objects) are skipped by both persistence subsystems — never crash a
+    search over a cache entry; a dropped one only costs a retrain.
+    """
+    try:
+        json.dumps(key)
+    except (TypeError, ValueError):
+        return False
+    return True
+
+
+@contextlib.contextmanager
+def _file_lock(path: str):
+    """Exclusive advisory lock serializing read-merge-write cycles.
+
+    Uses a sidecar ``<path>.lock`` (flock on the data file itself would be
+    lost across the atomic rename).  Best-effort on platforms without
+    fcntl — the write itself stays atomic either way.
+    """
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover - non-POSIX
+        yield
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def load_fitness_cache(path: str) -> Dict[Any, float]:
+    """Fitness cache from ``path`` (empty dict when the file doesn't exist).
+
+    The returned dict is a plain ``fitness_cache`` for any Population.
+    """
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        payload = json.load(f)
+    return {tuplify(k): float(v) for k, v in payload["entries"]}
+
+
+def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
+    """Merge ``cache`` into ``path`` atomically; returns total entries stored.
+
+    The read-merge-write cycle runs under an exclusive file lock, so
+    concurrent savers serialize instead of losing each other's new
+    entries; on a key collision the in-memory value wins (it is the most
+    recent measurement).  Non-JSON-serializable keys are skipped silently,
+    per the checkpoint convention.
+    """
+    with _file_lock(path):
+        merged = load_fitness_cache(path)
+        for k, v in cache.items():
+            if not is_serializable_key(k):
+                continue
+            merged[k] = float(v)
+        payload = {"version": 1, "entries": [[k, v] for k, v in merged.items()]}
+        d = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".fitness-", suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    return len(merged)

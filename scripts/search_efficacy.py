@@ -158,7 +158,11 @@ def run_random(seed: int, budget: int, batch: int, x, y) -> list:
         accs = GeneticCnnModel.cross_validate_population(x, y, genomes, **params)
         trained += len(genomes)
         for g, a in zip(genomes, accs):
-            evaluated[canonical_key(g, NODES)] = (g, float(a))
+            key = canonical_key(g, NODES)
+            # Isomorphic re-draws keep the BEST measurement, mirroring what
+            # the GA arms see through their shared fitness cache.
+            if key not in evaluated or float(a) > evaluated[key][1]:
+                evaluated[key] = (g, float(a))
         best_fit = max(best_fit, float(np.max(accs)))
         curve.append((trained, best_fit))
     ranked = sorted(evaluated.values(), key=lambda gf: gf[1], reverse=True)

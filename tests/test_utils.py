@@ -76,6 +76,64 @@ class TestCheckpoint:
         assert leftovers == []
 
 
+class TestFitnessStore:
+    def test_round_trip_and_merge(self, tmp_path):
+        from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
+
+        path = str(tmp_path / "fit.json")
+        assert load_fitness_cache(path) == {}
+        a = {("GeneticCnnIndividual", ((1, 0), (0, 1)), ()): 0.91}
+        assert save_fitness_cache(a, path) == 1
+        # a second process adds a different key; our resave must keep it
+        b = {("GeneticCnnIndividual", ((1, 1), (1, 1)), ()): 0.95}
+        save_fitness_cache(b, path)
+        merged = load_fitness_cache(path)
+        assert len(merged) == 2
+        assert merged[("GeneticCnnIndividual", ((1, 0), (0, 1)), ())] == 0.91
+        # collision: in-memory value (most recent measurement) wins
+        save_fitness_cache({("GeneticCnnIndividual", ((1, 0), (0, 1)), ()): 0.5}, path)
+        assert load_fitness_cache(path)[("GeneticCnnIndividual", ((1, 0), (0, 1)), ())] == 0.5
+
+    def test_unserializable_keys_skipped(self, tmp_path):
+        from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
+
+        path = str(tmp_path / "fit.json")
+        cache = {("ok",): 1.0, ("bad", object()): 2.0}
+        assert save_fitness_cache(cache, path) == 1
+        assert load_fitness_cache(path) == {("ok",): 1.0}
+
+    def test_population_reuses_persisted_fitness(self, tmp_path):
+        """A second search over the same genomes trains NOTHING when seeded
+        with the stored cache — the cross-run reuse the store exists for."""
+        from gentun_tpu import Individual, Population, genetic_cnn_genome
+        from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
+
+        calls = {"n": 0}
+
+        class Counting(Individual):
+            def build_spec(self, **p):
+                return genetic_cnn_genome((4,))
+
+            def evaluate(self):
+                calls["n"] += 1
+                return float(sum(sum(g) for g in self.genes.values()))
+
+        path = str(tmp_path / "fit.json")
+        data = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+        pop1 = Population(Counting, *data, size=6, seed=3)
+        pop1.evaluate()
+        first_calls = calls["n"]
+        assert first_calls > 0
+        save_fitness_cache(pop1.fitness_cache, path)
+
+        pop2 = Population(
+            Counting, *data, size=6, seed=3, fitness_cache=load_fitness_cache(path)
+        )
+        assert pop2.evaluate() == 0  # everything answered from the store
+        assert calls["n"] == first_calls
+        assert pop2.get_fitnesses() == pop1.get_fitnesses()
+
+
 class TestDatasets:
     def test_mnist_shape_and_real_source(self):
         x, y, meta = load_mnist()
