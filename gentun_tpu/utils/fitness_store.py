@@ -97,12 +97,30 @@ def load_fitness_cache(path: str) -> Dict[Any, float]:
     """Fitness cache from ``path`` (empty dict when the file doesn't exist).
 
     The returned dict is a plain ``fitness_cache`` for any Population.
+    A corrupt or schema-mismatched file degrades to an empty cache with a
+    loud warning (the original is preserved as ``<path>.corrupt``) — per
+    this module's convention, a cache must NEVER crash a search, least of
+    all at the end-of-run save that would lose the measurements.
     """
     if not os.path.exists(path):
         return {}
-    with open(path) as f:
-        payload = json.load(f)
-    return {tuplify(k): float(v) for k, v in payload["entries"]}
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        return {tuplify(k): float(v) for k, v in payload["entries"]}
+    except (ValueError, KeyError, TypeError) as e:
+        backup = path + ".corrupt"
+        try:
+            os.replace(path, backup)
+        except OSError:
+            backup = "<unmovable>"
+        import logging
+
+        logging.getLogger("gentun_tpu").warning(
+            "fitness store %s is unreadable (%s); starting empty, original "
+            "kept at %s", path, e, backup,
+        )
+        return {}
 
 
 def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
@@ -114,6 +132,8 @@ def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
     recent measurement).  Non-JSON-serializable keys are skipped silently,
     per the checkpoint convention.
     """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)  # before locking: works with or without fcntl
     with _file_lock(path):
         merged = load_fitness_cache(path)
         for k, v in cache.items():
@@ -121,7 +141,6 @@ def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
                 continue
             merged[k] = float(v)
         payload = {"version": 1, "entries": [[k, v] for k, v in merged.items()]}
-        d = os.path.dirname(os.path.abspath(path))
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".fitness-", suffix=".json")
         try:
             with os.fdopen(fd, "w") as f:

@@ -42,6 +42,10 @@ def main():
     ap.add_argument("--population", type=int, default=10)
     ap.add_argument("--out", default="RESULTS.md")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fitness-store", default=None, metavar="PATH",
+                    help="persist/reuse measured fitnesses across runs "
+                         "(utils/fitness_store.py); repeated runs over the "
+                         "same data retrain only unseen architectures")
     args = ap.parse_args()
 
     x, y, meta = load_mnist()
@@ -53,6 +57,14 @@ def main():
     x_test, y_test = x[test_idx], y[test_idx]
     print(f"data: {meta['source']} — search {len(x_search)}, held-out test {len(x_test)}")
 
+    fitness_cache = None
+    if args.fitness_store:
+        from gentun_tpu.utils import load_fitness_cache
+
+        fitness_cache = load_fitness_cache(args.fitness_store)
+        if fitness_cache:
+            print(f"fitness store: {len(fitness_cache)} known architecture(s) loaded")
+
     pop = Population(
         GeneticCnnIndividual,
         x_train=x_search,
@@ -60,11 +72,18 @@ def main():
         size=args.population,
         seed=args.seed,
         additional_parameters=dict(FULL_SCHEDULE),
+        fitness_cache=fitness_cache,
     )
     ga = GeneticAlgorithm(pop, seed=args.seed)
     t0 = time.monotonic()
     best = ga.run(args.generations)
     search_s = time.monotonic() - t0
+
+    if args.fitness_store:
+        from gentun_tpu.utils import save_fitness_cache
+
+        total = save_fitness_cache(ga.population.fitness_cache, args.fitness_store)
+        print(f"fitness store: {total} architecture(s) persisted")
 
     test_acc = float(
         GeneticCnnModel.train_and_score(

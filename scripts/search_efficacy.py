@@ -159,10 +159,11 @@ def run_random(seed: int, budget: int, batch: int, x, y) -> list:
         trained += len(genomes)
         for g, a in zip(genomes, accs):
             key = canonical_key(g, NODES)
-            # Isomorphic re-draws keep the BEST measurement, mirroring what
-            # the GA arms see through their shared fitness cache.
-            if key not in evaluated or float(a) > evaluated[key][1]:
-                evaluated[key] = (g, float(a))
+            # Isomorphic re-draws keep the FIRST measurement — exactly the
+            # GA arms' policy (their shared fitness cache answers later
+            # duplicates with the first representative's fitness), so
+            # neither arm gets a max-of-k noise advantage in the ranking.
+            evaluated.setdefault(key, (g, float(a)))
         best_fit = max(best_fit, float(np.max(accs)))
         curve.append((trained, best_fit))
     ranked = sorted(evaluated.values(), key=lambda gf: gf[1], reverse=True)

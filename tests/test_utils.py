@@ -94,6 +94,19 @@ class TestFitnessStore:
         save_fitness_cache({("GeneticCnnIndividual", ((1, 0), (0, 1)), ()): 0.5}, path)
         assert load_fitness_cache(path)[("GeneticCnnIndividual", ((1, 0), (0, 1)), ())] == 0.5
 
+    def test_corrupt_store_degrades_to_empty_with_backup(self, tmp_path):
+        """A cache must never crash a search — least of all the end-of-run
+        save that would lose the measurements."""
+        from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
+
+        path = str(tmp_path / "fit.json")
+        (tmp_path / "fit.json").write_text("{truncated garbage")
+        assert load_fitness_cache(path) == {}
+        assert (tmp_path / "fit.json.corrupt").exists()  # original preserved
+        # and saving over the ruin works
+        assert save_fitness_cache({("a",): 1.0}, path) == 1
+        assert load_fitness_cache(path) == {("a",): 1.0}
+
     def test_unserializable_keys_skipped(self, tmp_path):
         from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
 
