@@ -145,7 +145,12 @@ class GeneticAlgorithm:
     # -- logging -----------------------------------------------------------
 
     def _log_generation(self, fittest: Individual, evaluated: int, elapsed_s: float) -> None:
-        n_chips = _initialized_chip_count()
+        # Distributed sweeps record the connected fleet's advertised chip
+        # total (workers' `hello` → broker.fleet_chips()); that is the true
+        # denominator for the per-chip metric — the master process itself
+        # never initializes jax, so its local count would always be 1.
+        stats = getattr(self.population, "eval_stats", None) or {}
+        n_chips = int(stats.get("n_chips") or 0) or _initialized_chip_count()
         record = {
             "generation": self.generation,
             "best_fitness": fittest.get_fitness(),
@@ -153,13 +158,13 @@ class GeneticAlgorithm:
             "population_size": len(self.population),
             "evaluated": int(evaluated),  # individuals that actually trained
             "eval_wall_s": round(elapsed_s, 3),
+            "n_chips": n_chips,
             # the north-star metric (BASELINE.json): individuals/hour/chip
             "individuals_per_hour_per_chip": round(evaluated / (elapsed_s / 3600.0) / n_chips, 2),
         }
         # Distributed populations report their failure-recovery bookkeeping
         # (bounded retries / penalized stragglers) — record it so a resumed
         # or audited search can see exactly which generations degraded.
-        stats = getattr(self.population, "eval_stats", None)
         if stats and (stats.get("retries") or stats.get("penalized")):
             record["evaluate_attempts"] = stats["attempts"]
             record["evaluate_retries"] = stats["retries"]

@@ -89,15 +89,18 @@ class GatherTimeout(TimeoutError):
 class _Worker:
     """Per-connection state, touched only from the broker loop thread."""
 
-    __slots__ = ("worker_id", "writer", "capacity", "credit", "in_flight", "last_seen")
+    __slots__ = ("worker_id", "writer", "capacity", "credit", "in_flight", "last_seen", "n_chips", "backend")
 
-    def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int):
+    def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
+                 n_chips: int = 1, backend: Optional[str] = None):
         self.worker_id = worker_id
         self.writer = writer
         self.capacity = capacity
         self.credit = 0
         self.in_flight: Set[str] = set()
         self.last_seen = time.monotonic()
+        self.n_chips = n_chips
+        self.backend = backend
 
 
 class JobBroker:
@@ -382,6 +385,16 @@ class JobBroker:
         self.submit(payloads)
         return self.gather(list(payloads), timeout=timeout)
 
+    def fleet_chips(self) -> int:
+        """Total accelerator chips advertised by the connected workers (≥1).
+
+        Each worker's ``hello`` carries its ``n_chips`` (global device count
+        for a multi-host worker, 1 for non-jax species), so the master can
+        log the TRUE individuals/hour/chip for exactly the deployment the
+        metric was designed for.  Snapshot read — safe from any thread.
+        """
+        return max(1, sum(w.n_chips for w in list(self._workers.values())))
+
     @staticmethod
     def new_job_id() -> str:
         return uuid.uuid4().hex
@@ -468,14 +481,36 @@ class JobBroker:
                 writer.write(encode({"type": "error", "code": "auth", "reason": "bad token"}))
                 logger.warning("worker rejected: bad token")
                 return
+            try:
+                n_chips = max(1, int(hello.get("n_chips", 1)))
+            except (TypeError, ValueError):
+                n_chips = 1  # malformed advertisement: degrade, don't drop
+            backend = hello.get("backend") or None
             worker = _Worker(
                 worker_id=str(hello.get("worker_id", f"worker-{wid}")),
                 writer=writer,
                 capacity=max(1, int(hello.get("capacity", 1))),
+                n_chips=n_chips,
+                backend=str(backend) if backend is not None else None,
             )
+            # Heterogeneous-fleet check (ADVICE r3): two workers scoring one
+            # generation with different estimators (e.g. xgb.cv on one host,
+            # sklearn HistGradientBoosting on another) produce incomparable
+            # fitnesses — warn the operator the moment the second one joins.
+            others = {w.backend for w in self._workers.values() if w.backend}
+            if worker.backend and others and others != {worker.backend}:
+                logger.warning(
+                    "heterogeneous fitness backends in the fleet: worker %s "
+                    "uses %s but connected workers use %s — fitnesses from "
+                    "different backends are not comparable within a generation",
+                    worker.worker_id, worker.backend, sorted(others),
+                )
             self._workers[wid] = worker
             writer.write(encode({"type": "welcome"}))
-            logger.info("worker %s connected (capacity %d)", worker.worker_id, worker.capacity)
+            logger.info(
+                "worker %s connected (capacity %d, %d chip(s))",
+                worker.worker_id, worker.capacity, worker.n_chips,
+            )
 
             while True:
                 line = await reader.readline()

@@ -73,6 +73,7 @@ class GentunClient:
         reconnect_delay: float = 1.0,
         worker_id: Optional[str] = None,
         multihost: bool = False,
+        n_chips: Optional[int] = None,
     ):
         self.species = species
         self.x_train = x_train
@@ -84,6 +85,7 @@ class GentunClient:
         self.heartbeat_interval = float(heartbeat_interval)
         self.reconnect_delay = float(reconnect_delay)
         self.worker_id = worker_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self._n_chips = None if n_chips is None else max(1, int(n_chips))
         self.multihost = bool(multihost)
         if self.multihost:
             from ..parallel import multihost as mh  # imports jax (opt-in only)
@@ -103,12 +105,44 @@ class GentunClient:
 
     # -- connection --------------------------------------------------------
 
+    def _fleet_chips(self) -> int:
+        """Accelerator chips this logical worker spans, for the ``hello`` frame.
+
+        The master divides its throughput metric by the connected fleet's
+        chip total (``individuals/hour/chip`` — SURVEY.md §5 "Metrics"), so
+        the advertisement must be honest: ``jax.device_count()`` is GLOBAL
+        (``local_device_count × process_count``), which is exactly one
+        multi-host worker's slice-wide chip count.  Species that never touch
+        jax report 1 and never trigger a backend init here.  Override with
+        the ``n_chips`` constructor kwarg.
+        """
+        if self._n_chips is None:
+            if getattr(self.species, "uses_jax", False):
+                import jax  # the fitness path initializes this backend anyway
+
+                self._n_chips = max(1, int(jax.device_count()))
+            else:
+                self._n_chips = 1
+        return self._n_chips
+
     def _connect(self) -> None:
+        n_chips = self._fleet_chips()  # before the socket: may compile-init jax
         sock = socket.create_connection((self.host, self.port), timeout=10.0)
         sock.settimeout(None)
         self._sock = sock
         self._rfile = sock.makefile("rb")
-        self._send({"type": "hello", "worker_id": self.worker_id, "token": self.token, "capacity": self.capacity})
+        try:
+            backend = self.species.fitness_backend()
+        except Exception:  # never let an advisory field block the handshake
+            backend = None
+        self._send({
+            "type": "hello",
+            "worker_id": self.worker_id,
+            "token": self.token,
+            "capacity": self.capacity,
+            "n_chips": n_chips,
+            "backend": backend,
+        })
         reply = self._recv()
         if reply.get("type") != "welcome":
             if reply.get("type") == "error" and reply.get("code") == "auth":
@@ -212,11 +246,19 @@ class GentunClient:
         leader decides when the worker is done via the shutdown sentinel.
         """
         self._jobs_done = 0
-        while True:
-            jobs = self._mh.broadcast_payload(None)
-            if jobs is None:
-                return self._jobs_done
-            self._evaluate_batch(jobs)
+        # Bounded exit if the leader dies without sending the sentinel
+        # (SIGKILL/OOM): probe its coordination-service port and hard-exit
+        # nonzero within ~10 s instead of hanging in the collective until
+        # the runtime's own timeout (``parallel/multihost.py``).
+        watchdog_stop = self._mh.start_leader_watchdog()
+        try:
+            while True:
+                jobs = self._mh.broadcast_payload(None)
+                if jobs is None:
+                    return self._jobs_done
+                self._evaluate_batch(jobs)
+        finally:
+            watchdog_stop.set()
 
     def _consume(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):

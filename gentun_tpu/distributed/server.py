@@ -59,6 +59,13 @@ class DistributedPopulation(Population):
       fitness observed in the generation (never cached — a penalty is not
       a measurement) and lets the search continue, unless NOTHING
       evaluated at all, which still raises.
+    - ``fitness_store``: path to a cross-run fitness store
+      (``utils/fitness_store.py``).  Loaded at construction (in-memory
+      ``fitness_cache`` entries win on collision) and merged back
+      atomically at :meth:`close` — a repeated distributed search over
+      already-measured genomes ships ZERO jobs.  The store rides
+      ``clone_with``, so closing whichever generation's population the
+      caller ends up holding saves every fitness the search measured.
     """
 
     def __init__(
@@ -83,9 +90,23 @@ class DistributedPopulation(Population):
         fitness_cache: Optional[Dict[Any, float]] = None,
         evaluate_retries: int = 0,
         failed_policy: str = "raise",
+        fitness_store: Optional[str] = None,
     ):
         if failed_policy not in ("raise", "penalize"):
             raise ValueError(f"unknown failed_policy {failed_policy!r}")
+        self.fitness_store = fitness_store
+        if fitness_store:
+            from ..utils.fitness_store import load_fitness_cache
+
+            loaded = load_fitness_cache(fitness_store)
+            if fitness_cache is None:
+                fitness_cache = loaded
+            else:
+                # Merge IN PLACE so the provided dict keeps its identity
+                # (clones share the cache object); live measurements beat
+                # stored ones, hence setdefault.
+                for k, v in loaded.items():
+                    fitness_cache.setdefault(k, v)
         super().__init__(
             species,
             x_train=None,
@@ -126,8 +147,17 @@ class DistributedPopulation(Population):
         return self.broker.address
 
     def close(self) -> None:
-        if self._owns_broker:
-            self.broker.stop()
+        # Persist first (a stopped broker must not lose fitnesses), but a
+        # save failure must not leave the listener running either.
+        try:
+            if self.fitness_store:
+                from ..utils.fitness_store import save_fitness_cache
+
+                n = save_fitness_cache(self.fitness_cache, self.fitness_store)
+                logger.info("fitness store %s: %d entries after merge", self.fitness_store, n)
+        finally:
+            if self._owns_broker:
+                self.broker.stop()
 
     def __enter__(self) -> "DistributedPopulation":
         return self
@@ -158,7 +188,12 @@ class DistributedPopulation(Population):
         while True:
             stats["attempts"] += 1
             try:
-                return completed + self._evaluate_once()
+                done = completed + self._evaluate_once()
+                # Sampled at sweep end so late-joining workers count: the
+                # GA's logger divides the north-star metric by this instead
+                # of the master's (jax-less, always-1) local chip count.
+                stats["n_chips"] = self.broker.fleet_chips()
+                return done
             except (JobFailed, GatherTimeout) as e:
                 completed += len(getattr(e, "partial", {}))
                 if stats["attempts"] <= self.evaluate_retries:
@@ -182,6 +217,7 @@ class DistributedPopulation(Population):
                         "unfinished individual(s) with fitness %.6g (%s)",
                         stats["attempts"], stats["penalized"], worst, e,
                     )
+                    stats["n_chips"] = self.broker.fleet_chips()
                     return completed
                 raise
 
@@ -278,6 +314,9 @@ class DistributedPopulation(Population):
             evaluate_retries=self.evaluate_retries,
             failed_policy=self.failed_policy,
         )
+        # Carry the store path WITHOUT reloading the file every generation:
+        # the clone shares this population's cache dict already.
+        clone.fitness_store = self.fitness_store
         # An embedded broker stays closeable through evolution: every clone
         # of an owning population co-owns it, so close() on whichever
         # population the caller ends up holding (the GA hands back clones)

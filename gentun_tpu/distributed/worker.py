@@ -33,11 +33,12 @@ all 32 chips automatically (``jax.devices()`` is global after
 
 Operator note: the follower ranks exit when the leader's loop ends (a
 shutdown sentinel rides the last broadcast).  If the LEADER process is
-killed outright (no chance to send the sentinel), followers block in the
-broadcast collective until the jax distributed runtime times the
-collective out and aborts them — restart the worker command on all hosts
-of the slice together, like any SPMD job.  The master side needs no
-action either way: unacked jobs redeliver to other workers.
+killed outright (no chance to send the sentinel), each follower's leader
+watchdog (``parallel/multihost.py: start_leader_watchdog``) notices the
+dead coordination service within ~10 s and hard-exits that rank with
+code 17 — restart the worker command on all hosts of the slice together,
+like any SPMD job.  The master side needs no action either way: unacked
+jobs redeliver to other workers.
 """
 
 from __future__ import annotations
@@ -116,6 +117,9 @@ def main(argv=None) -> int:
     ap.add_argument("--capacity", type=int, default=1,
                     help="jobs taken at once; >1 trains the batch as one vmapped program")
     ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--n-chips", type=int, default=None,
+                    help="override the advertised accelerator chip count "
+                         "(default: jax.device_count() for jax species, 1 otherwise)")
     ap.add_argument("--max-jobs", type=int, default=None, help="exit after this many results")
     mh = ap.add_argument_group(
         "multi-host",
@@ -165,6 +169,7 @@ def main(argv=None) -> int:
         capacity=args.capacity,
         worker_id=args.worker_id,
         multihost=multihost,
+        n_chips=args.n_chips,
     )
     try:
         done = client.work(max_jobs=args.max_jobs)

@@ -58,6 +58,12 @@ class Individual:
     *data* stays local and only genes cross process boundaries (SURVEY.md §1).
     """
 
+    #: True for species whose fitness path initializes a jax backend.  The
+    #: distributed worker uses this to advertise its accelerator chip count
+    #: in the broker handshake (``distributed/client.py``) without forcing a
+    #: backend init for species that never touch jax.
+    uses_jax: bool = False
+
     def __init__(
         self,
         x_train=None,
@@ -187,6 +193,18 @@ class Individual:
 
     # -- misc --------------------------------------------------------------
 
+    @classmethod
+    def fitness_backend(cls) -> Optional[str]:
+        """Name of the fitness-model backend this species trains with, or None.
+
+        Advertised in the distributed worker's ``hello`` so the master can
+        warn when a mixed fleet would score one generation with two
+        different estimators (ADVICE r3: a worker with xgboost installed
+        and one without silently return incomparable fitnesses).
+        """
+        model_cls = getattr(cls, "model_cls", None)
+        return model_cls.__name__ if model_cls is not None else None
+
     def __repr__(self) -> str:
         fit = f"{self._fitness:.6g}" if self._fitness is not None else "unevaluated"
         return f"{type(self).__name__}(genes={self.genes}, fitness={fit})"
@@ -208,6 +226,12 @@ class GeneticCnnIndividual(Individual):
 
     #: set in tests to swap the fitness backend without touching the class
     model_cls: Optional[Type] = None
+
+    uses_jax = True  # fitness trains on the jax backend → workers report chips
+
+    @classmethod
+    def fitness_backend(cls) -> Optional[str]:
+        return cls.model_cls.__name__ if cls.model_cls is not None else "GeneticCnnModel"
 
     def build_spec(self, **params) -> GenomeSpec:
         return genetic_cnn_genome(tuple(params.get("nodes", (3, 5))))
@@ -255,6 +279,14 @@ class BoostingIndividual(Individual):
     """
 
     model_cls: Optional[Type] = None
+
+    @classmethod
+    def fitness_backend(cls) -> Optional[str]:
+        if cls.model_cls is not None:
+            return cls.model_cls.__name__
+        from .models import default_boosting_model
+
+        return default_boosting_model().__name__
 
     def build_spec(self, **params) -> GenomeSpec:
         return boosting_genome()

@@ -10,9 +10,13 @@ environment, SURVEY.md §2.1).  Both accept the same
 backend-agnostic.
 """
 
+import logging
+
 from .generic import GentunModel
 
 __all__ = ["GentunModel", "default_boosting_model"]
+
+_backend_logged = False
 
 
 def default_boosting_model():
@@ -21,14 +25,28 @@ def default_boosting_model():
     Fallback chain: real xgboost (``models/xgboost.py`` — all 11 reference
     genes live) when importable, else the sklearn translation
     (``models/boosting.py`` — 7 of 11 live, warned loudly).
+
+    The selection is logged once per process (ADVICE r3): in a distributed
+    search a mixed fleet would otherwise silently score one generation with
+    two different estimators; workers also advertise the backend name in
+    their broker handshake so the MASTER warns on heterogeneity
+    (``distributed/broker.py``).
     """
+    global _backend_logged
     from .xgboost import XgboostModel, xgboost_available
 
     if xgboost_available():
-        return XgboostModel
-    from .boosting import BoostingModel
+        selected = XgboostModel
+    else:
+        from .boosting import BoostingModel
 
-    return BoostingModel
+        selected = BoostingModel
+    if not _backend_logged:
+        _backend_logged = True
+        logging.getLogger("gentun_tpu").info(
+            "boosting fitness backend: %s", selected.__name__
+        )
+    return selected
 
 try:  # jax/flax may be absent in minimal installs
     from .cnn import GeneticCnnModel, MaskedGeneticCnn  # noqa: F401

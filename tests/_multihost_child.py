@@ -98,17 +98,30 @@ def main() -> None:
         if multihost.is_leader():
             with open(out_path, "w") as f:
                 json.dump([float(a) for a in accs], f)
-    elif mode == "worker":
+    elif mode in ("worker", "worker-cnn"):
         broker_port, max_jobs = int(sys.argv[6]), int(sys.argv[7])
         from gentun_tpu.distributed import GentunClient
 
-        data = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+        if mode == "worker-cnn":
+            # The v5e-32 worker's EXACT composition (VERDICT r3 item 4):
+            # broker jobs → leader broadcast → Population.evaluate →
+            # sharded GeneticCnnModel CV across the process cluster.
+            from gentun_tpu.individuals import GeneticCnnIndividual
+
+            species = GeneticCnnIndividual
+            x, y, _, _ = build_workload()
+            data = (x, y)
+            capacity = 4
+        else:
+            species = _one_max_species()
+            data = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+            capacity = 2
         client = GentunClient(
-            _one_max_species(),
+            species,
             *data,
             host="127.0.0.1",
             port=broker_port,
-            capacity=2,
+            capacity=capacity,
             heartbeat_interval=0.2,
             reconnect_delay=0.1,
             multihost=True,

@@ -156,3 +156,91 @@ def test_multihost_worker_completes_jobs(tmp_path):
             if p.poll() is None:
                 p.kill()
         broker.stop()
+
+
+def test_follower_exits_bounded_when_leader_sigkilled(tmp_path):
+    """VERDICT r3 item 8: SIGKILL the leader rank (no shutdown sentinel) —
+    the follower must exit nonzero within a bounded time instead of hanging
+    until the runtime's collective timeout.  Code 17 is the leader
+    watchdog's signature (multihost.start_leader_watchdog); a fast
+    collective-layer failure may occasionally beat the watchdog, which is
+    an equally bounded nonzero exit."""
+    from gentun_tpu.distributed import JobBroker
+
+    broker = JobBroker(port=0).start()
+    procs = []
+    try:
+        _, port = broker.address
+        out_path = str(tmp_path / "wd.json")
+        procs = _spawn_cluster("worker", out_path, extra_args=(port, 100))
+        deadline = time.monotonic() + 240.0
+        while not broker._workers and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert broker._workers, "leader never connected to the broker"
+        time.sleep(1.0)  # follower is in its broadcast loop, watchdog armed
+        procs[0].kill()  # SIGKILL: the sentinel can never be sent
+        t0 = time.monotonic()
+        out, _ = procs[1].communicate(timeout=60.0)
+        elapsed = time.monotonic() - t0
+        assert procs[1].returncode not in (0, None), out.decode(errors="replace")[-2000:]
+        assert elapsed < 45.0, f"follower took {elapsed:.1f}s to notice leader death"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        broker.stop()
+
+
+def test_multihost_worker_real_cnn_matches_single_process(tmp_path):
+    """VERDICT r3 item 4 — the v5e-32 worker's exact composition, end to
+    end: master barrier → broker jobs → leader broadcast over the device
+    fabric → ``Population.evaluate`` → sharded ``GeneticCnnModel`` CV
+    across a 2-process cluster.  Fitnesses must match a single-process
+    evaluation of the same genomes under the same (auto) mesh logic, and
+    the worker must advertise the slice's full chip count."""
+    sys.path.insert(0, os.path.dirname(CHILD))
+    try:
+        from _multihost_child import build_workload
+    finally:
+        sys.path.pop(0)
+    from gentun_tpu.distributed import JobBroker
+    from gentun_tpu.models.cnn import GeneticCnnModel
+
+    x, y, genomes, config = build_workload()
+    # Single-process reference with the same default-mesh choice the worker
+    # makes (8 global devices in both worlds → identical program).
+    want = np.asarray(
+        GeneticCnnModel.cross_validate_population(x, y, genomes, **config),
+        dtype=np.float32,
+    )
+
+    payloads = {
+        f"cnn-{i}": {
+            "genes": {k: list(v) for k, v in g.items()},
+            "additional_parameters": {
+                k: (list(v) if isinstance(v, tuple) else v) for k, v in config.items()
+            },
+        }
+        for i, g in enumerate(genomes)
+    }
+    broker = JobBroker(port=0).start()
+    procs = []
+    try:
+        _, port = broker.address
+        out_path = str(tmp_path / "cnn_worker.json")
+        procs = _spawn_cluster("worker-cnn", out_path, extra_args=(port, len(payloads)))
+        broker.submit(payloads)
+        results = broker.gather(list(payloads), timeout=480.0)
+        got = np.asarray([results[f"cnn-{i}"] for i in range(len(genomes))], dtype=np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # One logical worker spanning the whole 8-device slice advertises
+        # all of it (VERDICT r3 item 3 exercised on the real species).
+        assert broker.fleet_chips() == 8
+        _join(procs, timeout=120.0)
+        with open(out_path + ".rank1") as f:
+            assert json.load(f)["jobs_done"] == len(payloads)  # lockstep rank
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        broker.stop()

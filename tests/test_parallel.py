@@ -107,3 +107,58 @@ class TestShardedTraining:
         )
         assert accs.shape == (2,)
         assert (accs > 0.4).all()
+
+
+class TestLeaderWatchdog:
+    """VERDICT r3 item 8: followers exit nonzero within a bounded time when
+    the leader dies without sending the shutdown sentinel."""
+
+    def _as_follower(self, monkeypatch, coordinator):
+        from gentun_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "is_leader", lambda: False)
+        monkeypatch.setattr(multihost, "process_index", lambda: 1)
+        monkeypatch.setattr(multihost, "_coordinator", coordinator)
+        return multihost
+
+    def test_exits_17_on_dead_coordinator(self, monkeypatch):
+        import socket as _socket
+        import time as _time
+
+        with _socket.socket() as s:  # grab a port nobody listens on
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        mh = self._as_follower(monkeypatch, f"127.0.0.1:{dead_port}")
+        exits = []
+        stop = mh.start_leader_watchdog(interval=0.05, grace=2, _exit=exits.append)
+        try:
+            deadline = _time.monotonic() + 5.0
+            while not exits and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            assert exits == [17]
+        finally:
+            stop.set()
+
+    def test_quiet_while_coordinator_alive_and_stoppable(self, monkeypatch):
+        import socket as _socket
+        import time as _time
+
+        srv = _socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        try:
+            mh = self._as_follower(monkeypatch, f"127.0.0.1:{srv.getsockname()[1]}")
+            exits = []
+            stop = mh.start_leader_watchdog(interval=0.05, grace=2, _exit=exits.append)
+            _time.sleep(0.5)
+            stop.set()  # clean sentinel path
+            assert exits == []
+        finally:
+            srv.close()
+
+    def test_noop_on_leader(self):
+        from gentun_tpu.parallel import multihost
+
+        exits = []
+        stop = multihost.start_leader_watchdog(_exit=exits.append)
+        assert not stop.is_set() and exits == []  # returned without a thread
