@@ -16,12 +16,16 @@ from gentun_tpu.utils import EvalTimer
 from gentun_tpu.utils.datasets import load_cifar100
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--generations", type=int, default=20)
     ap.add_argument("--population", type=int, default=50)
     ap.add_argument("--n-images", type=int, default=10_000)
-    args = ap.parse_args()
+    ap.add_argument("--kernels", type=int, nargs="+", default=[64, 128, 256],
+                    help="filters per stage (smaller = faster smoke runs)")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--dense-units", type=int, default=512)
+    args = ap.parse_args(argv)
 
     x, y, meta = load_cifar100(n=args.n_images)
     print(f"data: {meta['source']} ({len(x)} images, 100 classes)")
@@ -34,12 +38,12 @@ def main():
         seed=0,
         additional_parameters=dict(
             nodes=(5, 5, 5),
-            kernels_per_layer=(64, 128, 256),
+            kernels_per_layer=tuple(args.kernels),
             kfold=2,
             epochs=(1,),
             learning_rate=(0.01,),
-            batch_size=256,
-            dense_units=512,
+            batch_size=args.batch_size,
+            dense_units=args.dense_units,
             compute_dtype="bfloat16",
             seed=0,
         ),

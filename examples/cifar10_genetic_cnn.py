@@ -17,7 +17,7 @@ from gentun_tpu.utils import Checkpointer, EvalTimer
 from gentun_tpu.utils.datasets import load_cifar10
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--generations", type=int, default=50)
     ap.add_argument("--population", type=int, default=20)
@@ -25,8 +25,12 @@ def main():
     ap.add_argument("--kfold", type=int, default=2)
     ap.add_argument("--epochs", type=int, nargs="+", default=[1])
     ap.add_argument("--lr", type=float, nargs="+", default=[0.01])
+    ap.add_argument("--kernels", type=int, nargs="+", default=[32, 64, 128],
+                    help="filters per stage (smaller = faster smoke runs)")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--dense-units", type=int, default=256)
     ap.add_argument("--checkpoint", default="")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     x, y, meta = load_cifar10(n=args.n_images)
     print(f"data: {meta['source']} ({len(x)} images)")
@@ -39,12 +43,12 @@ def main():
         seed=0,
         additional_parameters=dict(
             nodes=(3, 4, 5),
-            kernels_per_layer=(32, 64, 128),
+            kernels_per_layer=tuple(args.kernels),
             kfold=args.kfold,
             epochs=tuple(args.epochs),
             learning_rate=tuple(args.lr),
-            batch_size=256,
-            dense_units=256,
+            batch_size=args.batch_size,
+            dense_units=args.dense_units,
             compute_dtype="bfloat16",
             seed=0,
         ),

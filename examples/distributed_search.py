@@ -62,6 +62,9 @@ def run_master(args):
         # or straggler timeout re-ships only the unfinished individuals
         # instead of killing the run (see README "Distributed search").
         evaluate_retries=3,
+        # Cross-run reuse: architectures measured by ANY previous search
+        # against this store are answered from the file and never reshipped.
+        fitness_store=args.fitness_store or None,
     ) as pop:
         print(f"broker listening on port {pop.broker_address[1]}; waiting for workers")
         best = GeneticAlgorithm(pop, seed=0).run(args.generations)
@@ -94,8 +97,12 @@ def run_demo(args):
     from gentun_tpu.utils.datasets import load_cifar10
 
     params = dict(CNN_PARAMS)
-    params.update(kernels_per_layer=(8, 8, 8), dense_units=32, batch_size=64)
-    x, y, _ = load_cifar10(n=512)
+    params.update(
+        kernels_per_layer=tuple(args.kernels),
+        dense_units=32,
+        batch_size=args.batch_size,
+    )
+    x, y, _ = load_cifar10(n=args.n_images)
     with DistributedPopulation(
         GeneticCnnIndividual, size=6, seed=0,
         additional_parameters=params, port=0,
@@ -116,7 +123,7 @@ def run_demo(args):
             stop.set()
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="role", required=True)
     m = sub.add_parser("master")
@@ -124,6 +131,8 @@ def main():
     m.add_argument("--password", default="")
     m.add_argument("--population", type=int, default=20)
     m.add_argument("--generations", type=int, default=50)
+    m.add_argument("--fitness-store", default="",
+                   help="cross-run fitness store path (utils/fitness_store.py)")
     w = sub.add_parser("worker")
     w.add_argument("--host", default="127.0.0.1")
     w.add_argument("--port", type=int, default=5672)
@@ -132,7 +141,10 @@ def main():
     w.add_argument("--n-images", type=int, default=10_000)
     d = sub.add_parser("demo")
     d.add_argument("--generations", type=int, default=2)
-    args = ap.parse_args()
+    d.add_argument("--n-images", type=int, default=512)
+    d.add_argument("--kernels", type=int, nargs="+", default=[8, 8, 8])
+    d.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
     {"master": run_master, "worker": run_worker, "demo": run_demo}[args.role](args)
 
 

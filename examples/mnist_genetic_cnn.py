@@ -17,23 +17,30 @@ from gentun_tpu.utils import Checkpointer
 from gentun_tpu.utils.datasets import load_mnist
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--generations", type=int, default=5)
     ap.add_argument("--population", type=int, default=10)
     ap.add_argument("--kfold", type=int, default=3)
     ap.add_argument("--epochs", type=int, nargs="+", default=[3])
     ap.add_argument("--lr", type=float, nargs="+", default=[0.01])
+    ap.add_argument("--n-images", type=int, default=None, help="subsample the dataset")
+    ap.add_argument("--kernels", type=int, nargs="+", default=[20, 50],
+                    help="filters per stage (smaller = faster smoke runs)")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--dense-units", type=int, default=500)
     ap.add_argument("--checkpoint", default="")
     ap.add_argument("--cpu", action="store_true", help="force CPU (no TPU touch)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
 
-    x, y, meta = load_mnist()
+    if args.n_images is not None and args.n_images <= 0:
+        raise SystemExit(f"--n-images must be positive, got {args.n_images}")
+    x, y, meta = load_mnist(**({"n": args.n_images} if args.n_images is not None else {}))
     print(f"data: {meta['source']} ({len(x)} images)")
 
     pop = Population(
@@ -44,12 +51,12 @@ def main():
         seed=0,
         additional_parameters=dict(
             nodes=(3, 5),
-            kernels_per_layer=(20, 50),
+            kernels_per_layer=tuple(args.kernels),
             kfold=args.kfold,
             epochs=tuple(args.epochs),
             learning_rate=tuple(args.lr),
-            batch_size=128,
-            dense_units=500,
+            batch_size=args.batch_size,
+            dense_units=args.dense_units,
             seed=0,
         ),
     )

@@ -17,13 +17,13 @@ from gentun_tpu import BoostingIndividual, GeneticAlgorithm, Population
 from gentun_tpu.utils.datasets import load_uci_binary, load_uci_wine
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", choices=["wine", "binary"], default="wine")
     ap.add_argument("--generations", type=int, default=10)
     ap.add_argument("--population", type=int, default=20)
     ap.add_argument("--kfold", type=int, default=5)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     x, y, meta = load_uci_wine() if args.dataset == "wine" else load_uci_binary()
     print(f"data: {meta['source']} ({x.shape[0]} rows, {x.shape[1]} features)")
