@@ -1,0 +1,241 @@
+"""The flagship capability on real hardware: a distributed Genetic-CNN
+search driven by a jax-less master through the embedded broker, with the
+training done by a ``GentunClient`` worker on the actual TPU chip.
+
+VERDICT r3 item 1: every TPU number recorded before round 4 came from the
+single-process ``cross_validate_population`` path; this script produces the
+missing artifact — a master + worker search on hardware, with per-generation
+wall times, retry stats, capacity-batch evidence, and an apples-to-apples
+single-process comparison run of the same schedule (run sequentially, in a
+separate process, respecting the one-TPU-process rule).
+
+Shapes follow BASELINE config #4 (SURVEY.md §6): CIFAR-10-sized data,
+S=(3, 4, 5), pop=20, proxy generations plus one reference-default
+full-schedule generation.  The configs are bench.py's PROXY/FULL so the
+numbers are directly comparable with BENCH_r{N}.json.
+
+Usage (two processes, master first):
+
+    python scripts/distributed_tpu_run.py master --port 56720 \
+        --generations 10 --out scripts/distributed_tpu_run.json
+    python -m gentun_tpu.distributed.worker --port 56720 \
+        --species genetic-cnn --dataset cifar10 --n 10000 --capacity 20
+
+    # afterwards (worker exited/killed), the comparison run:
+    python scripts/distributed_tpu_run.py single --generations 10 \
+        --out scripts/distributed_tpu_single.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POP = 20
+N_DATA = 10_000
+
+# bench.py's exact schedules (kept in sync by tests/test_bench_meta.py's
+# import convention: bench.py is importable from the repo root).
+COMMON = dict(
+    nodes=(3, 4, 5),
+    kernels_per_layer=(32, 64, 128),
+    batch_size=256,
+    dense_units=256,
+    compute_dtype="bfloat16",
+    seed=0,
+)
+PROXY = dict(COMMON, kfold=2, epochs=(1,), learning_rate=(0.01,))
+FULL = dict(COMMON, kfold=5, epochs=(20, 4, 1), learning_rate=(1e-2, 1e-3, 1e-4))
+
+
+def _schedules(args):
+    """(proxy, full, n_data) — tiny variants for the CPU rehearsal run."""
+    if getattr(args, "tiny", False):
+        tiny = dict(COMMON, kernels_per_layer=(4, 4, 4), batch_size=32, dense_units=16)
+        return (
+            dict(tiny, kfold=2, epochs=(1,), learning_rate=(0.01,)),
+            dict(tiny, kfold=2, epochs=(2, 1), learning_rate=(1e-2, 1e-3)),
+            96,
+        )
+    return dict(PROXY), dict(FULL), N_DATA
+
+
+def run_master(args) -> None:
+    # This process must NEVER import jax: the worker owns the chip (the
+    # one-TPU-process rule), and the master is pure bookkeeping + broker.
+    from gentun_tpu import GeneticAlgorithm, GeneticCnnIndividual
+    from gentun_tpu.distributed import DistributedPopulation
+    from gentun_tpu.utils.jax_state import backend_used
+
+    assert not backend_used(), "master must not initialize a jax backend (one-TPU-process rule)"
+    proxy_cfg, full_cfg, n_data = _schedules(args)
+
+    record = {
+        "workload": "distributed cifar10 genetic-cnn search (BASELINE config #4 shape)",
+        "pop": POP,
+        "proxy_schedule": f"kfold={proxy_cfg['kfold']} epochs={proxy_cfg['epochs']}",
+        "full_schedule": f"kfold={full_cfg['kfold']} epochs={full_cfg['epochs']} lr={full_cfg['learning_rate']}",
+        "n_data": n_data,
+    }
+    t_start = time.monotonic()
+    with DistributedPopulation(
+        GeneticCnnIndividual,
+        size=POP,
+        seed=0,
+        additional_parameters=dict(proxy_cfg),
+        host="127.0.0.1",
+        port=args.port,
+        job_timeout=args.job_timeout,
+        evaluate_retries=3,
+        fitness_store=args.fitness_store or None,
+    ) as pop:
+        print(f"broker listening on {pop.broker_address}; waiting for a worker", flush=True)
+        ga = GeneticAlgorithm(pop, seed=0)
+        t0 = time.monotonic()
+        best = ga.run(args.generations)
+        proxy_wall = time.monotonic() - t0
+        record["proxy"] = {
+            "generations": args.generations,
+            "wall_s": round(proxy_wall, 2),
+            "best_fitness": best.get_fitness(),
+            "evaluated_total": sum(h["evaluated"] for h in ga.history),
+            "history": ga.history,
+        }
+        evaluated = record["proxy"]["evaluated_total"]
+        # individuals/hour/chip over the whole proxy search, using the
+        # fleet-advertised chip count the workers reported per generation.
+        n_chips = max(h.get("n_chips", 1) for h in ga.history)
+        record["proxy"]["individuals_per_hour_per_chip"] = round(
+            evaluated / (proxy_wall / 3600.0) / n_chips, 2
+        )
+        record["proxy"]["n_chips"] = n_chips
+
+        # One reference-default full-schedule generation over the final
+        # population's genomes (fresh individuals: the proxy fitnesses must
+        # not cache-hit the full-schedule jobs — additional_parameters are
+        # part of the cache key, so they can't, but fresh objects also keep
+        # the bookkeeping clean).
+        genomes = [ind.get_genes() for ind in ga.population]
+        full_inds = [
+            GeneticCnnIndividual(genes=g, additional_parameters=dict(full_cfg))
+            for g in genomes
+        ]
+        full_pop = DistributedPopulation(
+            GeneticCnnIndividual,
+            individual_list=full_inds,
+            additional_parameters=dict(full_cfg),
+            broker=pop.broker,
+            job_timeout=args.job_timeout,
+            evaluate_retries=3,
+        )
+        t0 = time.monotonic()
+        shipped = full_pop.evaluate()
+        full_wall = time.monotonic() - t0
+        fits = [ind.get_fitness() for ind in full_pop]
+        record["full"] = {
+            "wall_s": round(full_wall, 2),
+            "shipped_jobs": shipped,
+            "eval_stats": dict(full_pop.eval_stats),
+            "individuals_per_hour_per_chip": round(
+                shipped / (full_wall / 3600.0) / max(1, full_pop.eval_stats.get("n_chips", 1)), 2
+            ),
+            "best_full_fitness": max(fits),
+            "mean_full_fitness": sum(fits) / len(fits),
+        }
+    record["total_wall_s"] = round(time.monotonic() - t_start, 2)
+    # Proof the master never touched the accelerator: all compute happened
+    # in the worker process (the reference's exact division of labor).
+    record["master_jax_backend_used"] = backend_used()
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({k: v for k, v in record.items() if k != "proxy"} |
+                     {"proxy_summary": {k: v for k, v in record["proxy"].items() if k != "history"}}))
+    print(f"artifact written to {args.out}", flush=True)
+
+
+def run_single(args) -> None:
+    """The comparison run: same search, single process, chip-local.
+
+    Run this AFTER the distributed worker has exited — it owns the TPU for
+    its duration (one-TPU-process rule).
+    """
+    from gentun_tpu import GeneticAlgorithm, GeneticCnnIndividual, Population
+    from gentun_tpu.utils.datasets import load_cifar10
+
+    proxy_cfg, full_cfg, n_data = _schedules(args)
+    x, y, meta = load_cifar10(n=n_data)
+    record = {"data": meta.get("source"), "pop": POP}
+    pop = Population(
+        GeneticCnnIndividual,
+        x_train=x,
+        y_train=y,
+        size=POP,
+        seed=0,
+        additional_parameters=dict(proxy_cfg),
+    )
+    ga = GeneticAlgorithm(pop, seed=0)
+    t0 = time.monotonic()
+    best = ga.run(args.generations)
+    proxy_wall = time.monotonic() - t0
+    evaluated = sum(h["evaluated"] for h in ga.history)
+    record["proxy"] = {
+        "generations": args.generations,
+        "wall_s": round(proxy_wall, 2),
+        "best_fitness": best.get_fitness(),
+        "evaluated_total": evaluated,
+        "individuals_per_hour_per_chip": round(evaluated / (proxy_wall / 3600.0), 2),
+        "history": ga.history,
+    }
+    genomes = [ind.get_genes() for ind in ga.population]
+    full_inds = [
+        GeneticCnnIndividual(
+            x_train=x, y_train=y, genes=g, additional_parameters=dict(full_cfg)
+        )
+        for g in genomes
+    ]
+    full_pop = Population(
+        GeneticCnnIndividual,
+        x_train=x,
+        y_train=y,
+        individual_list=full_inds,
+        additional_parameters=dict(full_cfg),
+    )
+    t0 = time.monotonic()
+    trained = full_pop.evaluate()
+    full_wall = time.monotonic() - t0
+    fits = [ind.get_fitness() for ind in full_pop]
+    record["full"] = {
+        "wall_s": round(full_wall, 2),
+        "trained": trained,
+        "individuals_per_hour_per_chip": round(trained / (full_wall / 3600.0), 2),
+        "best_full_fitness": max(fits),
+        "mean_full_fitness": sum(fits) / len(fits),
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"artifact written to {args.out}", flush=True)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="role", required=True)
+    m = sub.add_parser("master")
+    m.add_argument("--port", type=int, default=56720)
+    m.add_argument("--generations", type=int, default=10)
+    m.add_argument("--job-timeout", type=float, default=3600.0)
+    m.add_argument("--fitness-store", default="")
+    m.add_argument("--tiny", action="store_true", help="CPU rehearsal shapes")
+    m.add_argument("--out", default="scripts/distributed_tpu_run.json")
+    s = sub.add_parser("single")
+    s.add_argument("--generations", type=int, default=10)
+    s.add_argument("--tiny", action="store_true", help="CPU rehearsal shapes")
+    s.add_argument("--out", default="scripts/distributed_tpu_single.json")
+    args = ap.parse_args(argv)
+    {"master": run_master, "single": run_single}[args.role](args)
+
+
+if __name__ == "__main__":
+    main()
