@@ -1,0 +1,220 @@
+"""GPU-parity accuracy harness: one command against REAL MNIST/CIFAR-10.
+
+VERDICT r3 item 2 ("what's missing"): the reference trained real data to
+the Genetic-CNN paper's anchors (SURVEY.md §6 — ≈99.66% MNIST with
+S=(3, 5); ≈92.9% CIFAR-10 with S=(3, 4, 5)); this machine has no network,
+so those accuracies cannot be measured here.  This script turns the
+promise into a one-command check for any networked user:
+
+    # put real archives at $GENTUN_TPU_DATA/{mnist,cifar10}.npz
+    # (keys: x = images HWC float or uint8, y = int labels)
+    python scripts/parity.py            # both datasets
+    python scripts/parity.py --datasets mnist
+
+Per dataset: hold out a test split, run the canonical Genetic-CNN search
+(RussianRouletteGA — the paper's selection) with proxy-epoch fitness,
+retrain the winner on the full train split at the reference-default
+schedule (epochs (20, 4, 1), staged lr — SURVEY.md §3.4), and assert the
+TEST accuracy clears the anchor band.  Writes ``PARITY.md`` and exits
+nonzero on a band failure; missing archives are a LOUD skip (exit 3 when
+nothing could be measured), never a silent pass.
+
+The band defaults are deliberately under the paper anchors (99.3% vs
+99.66%, 90% vs 92.9%): single-run searches at modest budgets land within
+a band, not on a point.  Override with ``--band`` (tests do).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gentun_tpu import GeneticCnnIndividual, Population, RussianRouletteGA  # noqa: E402
+from gentun_tpu.models.cnn import GeneticCnnModel  # noqa: E402
+from gentun_tpu.utils.datasets import load_cifar10, load_mnist  # noqa: E402
+
+ANCHORS = {
+    "mnist": dict(
+        loader=load_mnist,
+        paper_acc=0.9966,  # Xie & Yuille ICCV 2017, S=(3, 5) [SURVEY §6]
+        band=0.993,
+        nodes=(3, 5),
+        kernels=(20, 50),
+        pop=10,
+        dense_units=500,
+        batch_size=128,
+        test_frac=1 / 7,  # 60k+10k MNIST → the canonical 10k test size
+    ),
+    "cifar10": dict(
+        loader=load_cifar10,
+        paper_acc=0.929,  # same paper, S=(3, 4, 5)
+        band=0.90,
+        nodes=(3, 4, 5),
+        kernels=(32, 64, 128),
+        pop=20,
+        dense_units=256,
+        batch_size=256,
+        test_frac=1 / 6,  # 50k+10k CIFAR → 10k test
+    ),
+}
+
+FULL_EPOCHS = (20, 4, 1)
+FULL_LR = (1e-2, 1e-3, 1e-4)
+
+
+def load_real(name: str, spec: dict, n_limit=None):
+    """The dataset ONLY if it is a real on-disk archive; None otherwise.
+
+    ``meta['source']`` ends with ``.npz`` exactly when ``_try_npz`` found
+    the user's archive — sklearn digits and synthetic fallbacks are real
+    code paths but NOT the paper's datasets, so parity refuses them.
+    """
+    kwargs = {} if n_limit is None else {"n": n_limit}
+    x, y, meta = spec["loader"](**kwargs)
+    if meta.get("synthetic") or not str(meta.get("source", "")).endswith(".npz"):
+        return None
+    return x, y, meta
+
+
+def run_one(name: str, spec: dict, args) -> dict:
+    data = load_real(name, spec, args.n_limit)
+    if data is None:
+        return {"dataset": name, "status": "SKIPPED",
+                "reason": f"no real archive at $GENTUN_TPU_DATA/{name}.npz"}
+    x, y, meta = data
+    n_test = max(1, int(len(x) * spec["test_frac"]))
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(x))
+    test_idx, train_idx = perm[:n_test], perm[n_test:]
+    x_tr, y_tr, x_te, y_te = x[train_idx], y[train_idx], x[test_idx], y[test_idx]
+
+    kernels = tuple(args.kernels) if args.kernels else spec["kernels"]
+    common = dict(
+        nodes=spec["nodes"],
+        kernels_per_layer=kernels,
+        dense_units=args.dense_units or spec["dense_units"],
+        batch_size=args.batch_size or spec["batch_size"],
+        compute_dtype="bfloat16",
+        seed=0,
+    )
+    proxy = dict(common, kfold=args.kfold, epochs=tuple(args.proxy_epochs),
+                 learning_rate=(0.01,))
+    t0 = time.time()
+    pop = Population(
+        GeneticCnnIndividual,
+        x_train=x_tr,
+        y_train=y_tr,
+        size=args.pop or spec["pop"],
+        seed=0,
+        additional_parameters=proxy,
+    )
+    ga = RussianRouletteGA(pop, seed=0)
+    best = ga.run(args.generations)
+
+    # The anchor is a TEST accuracy after full training, not a CV proxy:
+    # retrain the winner on the whole train split at the reference-default
+    # schedule and score the held-out test set.
+    full = dict(common, epochs=tuple(args.full_epochs or FULL_EPOCHS),
+                learning_rate=tuple(FULL_LR[: len(args.full_epochs or FULL_EPOCHS)]))
+    test_acc = float(
+        GeneticCnnModel.train_and_score(
+            x_tr, y_tr, x_te, y_te, [best.get_genes()], **full
+        )[0]
+    )
+    band = args.band if args.band is not None else spec["band"]
+    return {
+        "dataset": name,
+        "status": "PASS" if test_acc >= band else "FAIL",
+        "test_accuracy": round(test_acc, 4),
+        "band": band,
+        "paper_anchor": spec["paper_acc"],
+        "best_cv_fitness": round(best.get_fitness(), 4),
+        "best_genes": best.get_genes(),
+        "n_train": int(len(x_tr)),
+        "n_test": int(len(x_te)),
+        "source": meta["source"],
+        "generations": args.generations,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def write_markdown(rows, path: str) -> None:
+    lines = [
+        "# Accuracy parity vs the Genetic-CNN paper anchors (real data)",
+        "",
+        "Produced by `python scripts/parity.py` on a machine with the real",
+        "archives at `$GENTUN_TPU_DATA/{mnist,cifar10}.npz`.  Protocol per",
+        "dataset: hold out a test split, run the canonical RussianRouletteGA",
+        "search with proxy-epoch fitness, retrain the winner on the full",
+        "train split at the reference-default schedule (SURVEY.md §3.4),",
+        "score the held-out test set, assert the anchor band (SURVEY.md §6).",
+        "",
+        "| dataset | status | test accuracy | band | paper anchor | search |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if r["status"] == "SKIPPED":
+            lines.append(f"| {r['dataset']} | SKIPPED | — | — | — | {r['reason']} |")
+        else:
+            lines.append(
+                f"| {r['dataset']} | {r['status']} | {r['test_accuracy']:.4f} | "
+                f"≥ {r['band']} | {r['paper_anchor']} | "
+                f"{r['generations']} gens, {r['n_train']} train / {r['n_test']} test |"
+            )
+    lines += ["", "Full records: `scripts/parity.json`.", ""]
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--datasets", nargs="+", default=list(ANCHORS),
+                    choices=list(ANCHORS))
+    ap.add_argument("--generations", type=int, default=50)
+    ap.add_argument("--pop", type=int, default=None, help="override canonical pop size")
+    ap.add_argument("--kfold", type=int, default=2)
+    ap.add_argument("--proxy-epochs", type=int, nargs="+", default=[1])
+    ap.add_argument("--full-epochs", type=int, nargs="+", default=None)
+    ap.add_argument("--kernels", type=int, nargs="+", default=None)
+    ap.add_argument("--dense-units", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--n-limit", type=int, default=None, help="subsample the archive")
+    ap.add_argument("--band", type=float, default=None,
+                    help="override the per-dataset anchor band (tests)")
+    ap.add_argument("--out", default=None, help="PARITY.md path (default: repo root)")
+    args = ap.parse_args(argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_md = args.out or os.path.join(repo, "PARITY.md")
+
+    rows = [run_one(name, ANCHORS[name], args) for name in args.datasets]
+    for r in rows:
+        if r["status"] == "SKIPPED":
+            print(f"!!! PARITY SKIPPED for {r['dataset']}: {r['reason']} — "
+                  "this is NOT a pass", flush=True)
+        else:
+            print(f"parity {r['dataset']}: {r['status']} "
+                  f"(test {r['test_accuracy']:.4f} vs band {r['band']})", flush=True)
+
+    measured = [r for r in rows if r["status"] != "SKIPPED"]
+    if measured:
+        sidecar = (os.path.splitext(out_md)[0] + ".json" if args.out
+                   else os.path.join(repo, "scripts", "parity.json"))
+        with open(sidecar, "w") as f:
+            json.dump(rows, f, indent=1)
+        write_markdown(rows, out_md)
+        print(f"wrote {out_md}")
+    else:
+        print("!!! nothing measured: no real archives found — PARITY.md not written")
+        return 3
+    return 0 if all(r["status"] == "PASS" for r in measured) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
