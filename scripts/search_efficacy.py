@@ -49,6 +49,7 @@ from gentun_tpu.genes import genetic_cnn_genome  # noqa: E402
 from gentun_tpu.models.cnn import GeneticCnnModel  # noqa: E402
 from gentun_tpu.ops.dag import canonical_key  # noqa: E402
 from gentun_tpu.utils.datasets import load_mnist  # noqa: E402
+from gentun_tpu.utils.stats import fmt_paired, paired_row  # noqa: E402
 
 #: S=(3, 4, 5) ⇒ 3+6+10 = 19 bits ⇒ a 524k-architecture space: 100-odd
 #: random draws cover 0.02% of it, so structure exploitation (selection +
@@ -187,57 +188,6 @@ def paired_deltas(results: dict, arm: str, value_fn) -> np.ndarray:
     return np.asarray(
         [value_fn(r) - rand[r["seed"]] for r in results[arm] if r["seed"] in rand],
         dtype=np.float64,
-    )
-
-
-def sign_test_p(deltas: np.ndarray) -> float:
-    """Two-sided exact sign test on the non-zero paired deltas.
-
-    Computed from the exact Binomial(n, 1/2) pmf with ``math.comb`` — no
-    scipy dependency (it isn't in pyproject's dependency set): two-sided
-    p = sum of P(j) over all j whose pmf ≤ pmf(wins), the standard
-    minimum-likelihood definition (equals scipy.stats.binomtest here).
-    """
-    from math import comb
-
-    nz = deltas[deltas != 0]
-    n = len(nz)
-    if n == 0:
-        return 1.0
-    wins = int((nz > 0).sum())
-    pmf = [comb(n, j) * 0.5**n for j in range(n + 1)]
-    p = sum(pj for pj in pmf if pj <= pmf[wins] * (1 + 1e-12))
-    return float(min(1.0, p))
-
-
-def bootstrap_ci(deltas: np.ndarray, n_boot: int = 10_000, alpha: float = 0.05,
-                 seed: int = 0) -> tuple:
-    """Percentile bootstrap CI for the mean of paired deltas (seeded)."""
-    rng = np.random.default_rng(seed)
-    idx = rng.integers(0, len(deltas), size=(n_boot, len(deltas)))
-    means = deltas[idx].mean(axis=1)
-    return (float(np.quantile(means, alpha / 2)), float(np.quantile(means, 1 - alpha / 2)))
-
-
-def paired_row(deltas: np.ndarray) -> dict:
-    """The full paired summary for one comparison."""
-    lo, hi = bootstrap_ci(deltas)
-    return {
-        "mean": float(deltas.mean()),
-        "ci": (lo, hi),
-        "wins": int((deltas > 0).sum()),
-        "ties": int((deltas == 0).sum()),
-        "n": int(len(deltas)),
-        "p_sign": sign_test_p(deltas),
-    }
-
-
-def fmt_paired(s: dict) -> str:
-    return (
-        f"{s['mean']:+.4f} [{s['ci'][0]:+.4f}, {s['ci'][1]:+.4f}] | "
-        f"{s['wins']}/{s['n'] - s['ties']}"
-        + (f" ({s['ties']} ties)" if s["ties"] else "")
-        + f" | {s['p_sign']:.3f}"
     )
 
 

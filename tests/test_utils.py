@@ -218,3 +218,44 @@ class TestEvalTimer:
         s = t.summary()
         assert s["individuals"] == 16
         assert s["individuals_per_hour_per_chip"] > 0
+
+
+class TestPairedStats:
+    """gentun_tpu.utils.stats — shared by SEARCH.md and STAGE_EXIT_CONV.md."""
+
+    def test_sign_test_matches_scipy(self):
+        scipy_stats = pytest.importorskip("scipy.stats")
+        import numpy as np
+
+        from gentun_tpu.utils.stats import sign_test_p
+
+        rng = np.random.default_rng(0)
+        for n in (1, 2, 5, 10, 20):
+            for _ in range(10):
+                d = rng.choice([-1.0, 1.0, 0.0], size=n)
+                nz = d[d != 0]
+                want = 1.0 if len(nz) == 0 else float(
+                    scipy_stats.binomtest(int((nz > 0).sum()), n=len(nz), p=0.5).pvalue
+                )
+                assert abs(sign_test_p(d) - want) < 1e-9
+
+    def test_bootstrap_ci_brackets_mean_and_is_deterministic(self):
+        import numpy as np
+
+        from gentun_tpu.utils.stats import bootstrap_ci, paired_row
+
+        d = np.array([0.1, 0.2, 0.05, 0.15, 0.12, 0.08, 0.3, 0.02])
+        lo, hi = bootstrap_ci(d)
+        assert lo < d.mean() < hi
+        assert 0 < lo  # all-positive deltas: CI excludes zero
+        assert bootstrap_ci(d) == (lo, hi)  # seeded → reproducible
+        row = paired_row(d)
+        assert row["wins"] == 8 and row["ties"] == 0 and row["p_sign"] < 0.01
+
+    def test_paired_row_all_ties(self):
+        import numpy as np
+
+        from gentun_tpu.utils.stats import paired_row
+
+        row = paired_row(np.zeros(5))
+        assert row["p_sign"] == 1.0 and row["wins"] == 0 and row["ties"] == 5
