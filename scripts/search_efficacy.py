@@ -424,6 +424,39 @@ def write_markdown(results: dict, out_md: str, args) -> None:
             "selection re-amplifies lucky fitness measurements that "
             "tournament's rank-based selection is insensitive to."
         )
+    both_unresolved = all(
+        stats[(a, "holdout")]["ci"][0] <= 0 <= stats[(a, "holdout")]["ci"][1]
+        for a in ("tournament", "roulette")
+    )
+    if both_unresolved:
+        # Say plainly what the numbers show instead of hedging: when BOTH
+        # variants' winners carry more CV-optimism than random's, the CV
+        # advantage is partly selection-on-noise, and the minimal
+        # detectable transfer effect quantifies why holdout can't separate.
+        ho_sds = [
+            float(np.std(paired_deltas(results, a, lambda r: r["holdout"])))
+            for a in ("tournament", "roulette")
+        ]
+        n_seeds = stats[("tournament", "holdout")]["n"]
+        mde = 1.96 * max(ho_sds) / np.sqrt(n_seeds)
+        gap_t = optimism["tournament"] - optimism["random"]
+        gap_r = optimism["roulette"] - optimism["random"]
+        concl.append(
+            "Transfer verdict, plainly: on this workload NEITHER variant's CV "
+            "advantage measurably transfers to holdout, and the CV-optimism "
+            f"gap vs random (tournament {gap_t:+.4f}, roulette {gap_r:+.4f}) "
+            "shows why — picking top-3 by CV on noisy fitness measurements "
+            "inflates the winners' CV scores by roughly the size of the GA "
+            "advantage itself.  The minimal transfer effect detectable here "
+            f"is ≈{mde:.3f} (paired holdout sd {max(ho_sds):.3f}, n={n_seeds}); "
+            "any true difference is below that floor.  The honest claim this "
+            "artifact supports is therefore: the GA finds higher-CV-fitness "
+            "architectures than random at equal budget (CI-resolved), and at "
+            "this tiny-budget, high-noise regime that advantage is consumed "
+            "by selection noise rather than transferring — consistent with "
+            "the Genetic-CNN paper operating at ~100× this training budget "
+            "where fitness noise is far smaller."
+        )
     lines += [
         "",
         "**Takeaway:** " + "  ".join(concl),
