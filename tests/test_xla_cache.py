@@ -77,7 +77,12 @@ class TestPersistentCompilationCache:
         assert enable_compilation_cache(d) == enable_compilation_cache(d)
 
     def test_default_cache_dir_env(self, monkeypatch):
+        # ON by default (opt out with 0/off/none): a restarted search
+        # loads programs from disk instead of recompiling (DISTRIBUTED.md).
         monkeypatch.delenv("GENTUN_TPU_CACHE_DIR", raising=False)
-        assert default_cache_dir() is None
+        assert default_cache_dir().endswith("gentun_tpu/xla")
         monkeypatch.setenv("GENTUN_TPU_CACHE_DIR", "/tmp/foo")
         assert default_cache_dir() == "/tmp/foo"
+        for off in ("0", "off", "NONE", "disabled"):
+            monkeypatch.setenv("GENTUN_TPU_CACHE_DIR", off)
+            assert default_cache_dir() is None
