@@ -596,8 +596,14 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     ``train_and_score``.
     """
     # Persistent XLA compilation cache: a resumed/restarted search reuses
-    # the compiled program from disk (SURVEY.md §7 hard part #1).
-    cache_dir = cfg["cache_dir"] or default_cache_dir()
+    # the compiled program from disk (SURVEY.md §7 hard part #1).  ON by
+    # default; cache_dir=False (or "off"/"0"/"none") is the programmatic
+    # opt-out — None means "use the default", matching the env-var knob.
+    cache_dir = cfg["cache_dir"]
+    if cache_dir is None:
+        cache_dir = default_cache_dir()
+    elif cache_dir is False or str(cache_dir).strip().lower() in ("", "0", "off", "none", "disabled"):
+        cache_dir = None
     if cache_dir:
         enable_compilation_cache(cache_dir)
 

@@ -4,15 +4,20 @@ The masked-supergraph design already means one in-process compile serves the
 whole search space (``models/cnn.py``), but a *restarted* search — the whole
 point of the checkpoint/resume subsystem (``utils/checkpoint.py``) — would
 pay the full XLA compile again.  jax ships a persistent on-disk compilation
-cache; this module is the one place that turns it on, so every entry point
+cache; this module is the one place that manages it, so every entry point
 (models, bench, examples) shares the same knob.
 
-Two ways to enable it:
+The cache is **ON by default** at ``~/.cache/gentun_tpu/xla`` (measured
+3-6× cheaper than recompiling on restart — DISTRIBUTED.md).  Control it:
 
-- programmatically: ``enable_compilation_cache("/path/to/cache")`` (or pass
-  ``cache_dir=...`` to ``GeneticCnnModel`` / ``additional_parameters``);
-- environment: ``GENTUN_TPU_CACHE_DIR=/path/to/cache`` — picked up by
-  :func:`default_cache_dir` and applied automatically by the CNN model.
+- ``GENTUN_TPU_CACHE_DIR=/path/to/cache`` relocates it;
+- ``GENTUN_TPU_CACHE_DIR=off`` (or ``0``/``none``/``disabled``) turns it
+  off, as does ``cache_dir=False`` on ``GeneticCnnModel`` /
+  ``additional_parameters``;
+- ``enable_compilation_cache("/path")`` enables it programmatically.
+
+An unwritable cache directory degrades to caching disabled with a loud
+warning — it must never take the training path down.
 
 The thresholds are dropped to zero because GA fitness programs are small by
 XLA standards: the default "only cache compiles > 1 s / > 0 bytes" heuristics
@@ -60,7 +65,18 @@ def enable_compilation_cache(cache_dir: str) -> str:
     cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
     if _enabled_dir == cache_dir:
         return cache_dir
-    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        # On-by-default must not break environments with unwritable HOMEs
+        # (read-only containers, HOME=/nonexistent CI): degrade to no cache.
+        logger.warning(
+            "persistent XLA cache dir %s is unusable (%s); caching DISABLED "
+            "— set GENTUN_TPU_CACHE_DIR to a writable path or to 'off' to "
+            "silence this", cache_dir, e,
+        )
+        _enabled_dir = cache_dir  # don't retry (and re-warn) every call
+        return cache_dir
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -149,7 +149,9 @@ def main(argv=None) -> int:
         "(kfold=5, epochs=(20,4,1)), holdout scored for EVERY genome, and",
         "the decision read from PAIRED per-genome deltas (paper − bare sum)",
         "with a seeded bootstrap 95% CI and an exact sign test.",
-        f"Reproduce: `python scripts/stage_exit_conv_study.py` (one TPU chip).",
+        f"Reproduce: `python scripts/stage_exit_conv_study.py --noise "
+        f"{args.noise}` (one TPU chip; --noise was calibrated so holdout "
+        "sits well under 1.0).",
         "",
         "| workload | variant | CV mean | holdout mean | wall s |",
         "|---|---|---|---|---|",
@@ -199,6 +201,26 @@ def main(argv=None) -> int:
             "default stays **False** (one conv fewer per stage = marginally "
             "cheaper) with the paper variant one knob away."
         )
+        # Reconcile with the sign tests so the doc can't refute itself: a
+        # nominally-significant sign test with a near-zero effect size is
+        # direction without magnitude — name it rather than hide it.
+        notable = [
+            (name, m, s) for name, cv_s, ho_s in decisions
+            for m, s in (("CV", cv_s), ("holdout", ho_s)) if s["p_sign"] < 0.05
+        ]
+        if notable:
+            details = "; ".join(
+                f"{name} {m}: p={s['p_sign']:.3f}, mean Δ {s['mean']:+.4f}"
+                for name, m, s in notable
+            )
+            verdict += (
+                f"  Direction note: every cell leans against the paper "
+                f"variant, and the sign test is nominally significant for "
+                f"{details} — a consistent but practically-nil effect "
+                "(≲0.1pp); the CI rule, which weights magnitude, reads it "
+                "as no separation, and it argues for the bare-sum default, "
+                "not against it."
+            )
     lines += [
         "",
         "## Decision",

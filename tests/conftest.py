@@ -9,6 +9,11 @@ the top of conftest rather than in a fixture.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The persistent XLA cache is ON by default (utils/xla_cache.py); tests
+# must not populate the developer's real ~/.cache or flip the global jax
+# persistent-cache config from a test run.  setdefault so cache-specific
+# tests (and developers) can still opt in explicitly.
+os.environ.setdefault("GENTUN_TPU_CACHE_DIR", "off")
 existing = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in existing:
     os.environ["XLA_FLAGS"] = (existing + " --xla_force_host_platform_device_count=8").strip()

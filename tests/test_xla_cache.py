@@ -86,3 +86,31 @@ class TestPersistentCompilationCache:
         for off in ("0", "off", "NONE", "disabled"):
             monkeypatch.setenv("GENTUN_TPU_CACHE_DIR", off)
             assert default_cache_dir() is None
+
+
+class TestCacheOptOutAndDegrade:
+    def test_unwritable_dir_degrades_with_warning(self, caplog):
+        import logging
+
+        from gentun_tpu.utils import xla_cache
+
+        with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+            xla_cache.enable_compilation_cache("/proc/definitely/not/writable-x")
+        assert any("caching DISABLED" in r.message for r in caplog.records)
+
+    def test_cache_dir_false_is_programmatic_opt_out(self, monkeypatch):
+        import jax
+        import numpy as np
+
+        from gentun_tpu.models.cnn import GeneticCnnModel
+
+        monkeypatch.delenv("GENTUN_TPU_CACHE_DIR", raising=False)
+        before = jax.config.jax_compilation_cache_dir
+        x = np.random.default_rng(0).normal(size=(32, 8, 8, 1)).astype(np.float32)
+        y = np.zeros(32, np.int32)
+        GeneticCnnModel.cross_validate_population(
+            x, y, [{"S_1": (1, 0, 0)}], nodes=(3,), kernels_per_layer=(4,),
+            dense_units=8, kfold=2, epochs=(1,), learning_rate=(0.01,),
+            batch_size=16, seed=0, cache_dir=False,
+        )
+        assert jax.config.jax_compilation_cache_dir == before
