@@ -161,6 +161,28 @@ class GentunClient:
         self._sock = None
         self._rfile = None
 
+    def _graceful_close(self) -> None:
+        """FIN, then drain, then close — never RST away unread results.
+
+        A plain ``close()`` on a socket whose receive buffer still holds
+        unread broker frames emits RST, which destroys our just-sent
+        result frames before the broker reads them.  Shut down the write
+        side first (FIN queued AFTER the results), then read the
+        connection to EOF so nothing is left unread, then close.
+        """
+        sock = self._sock
+        if sock is None:
+            return
+        try:
+            sock.shutdown(socket.SHUT_WR)
+            sock.settimeout(5.0)
+            while sock.recv(4096):
+                pass
+        except OSError:
+            pass  # broker already gone: nothing left to protect
+        finally:
+            self._close()
+
     def _send(self, msg: Dict[str, Any]) -> None:
         with self._write_lock:
             sock = self._sock
@@ -231,7 +253,7 @@ class GentunClient:
                     time.sleep(self.reconnect_delay)
         finally:
             self._stop.set()
-            self._close()
+            self._graceful_close()
             if self.multihost:
                 self._mh.broadcast_payload(None)  # release the followers
         return self._jobs_done

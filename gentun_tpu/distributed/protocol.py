@@ -18,8 +18,19 @@ broker → worker       ``jobs`` {jobs: [{job_id, genes, additional_parameters},
 worker → broker       ``result`` {job_id, fitness}   = the ack (ack-after-work)
 worker → broker       ``fail`` {job_id, reason}      evaluation raised
 worker → broker       ``ping`` {}               liveness, from a side thread
-broker → worker       ``pong`` {}
 ====================  =====================================================
+
+``hello`` also carries advisory fields the broker uses for observability:
+``n_chips`` (the worker's accelerator count — denominates the master's
+per-chip metric) and ``backend`` (fitness-model class name — the broker
+warns on a heterogeneous fleet).
+
+Pings are deliberately UNANSWERED: the broker's ``last_seen`` update is
+the liveness mechanism, and replies the worker only reads between batches
+would pile up unread during a long training batch — a worker exiting
+right after its final results would then RST away the in-flight result
+frames (see ``client._graceful_close``).  Workers detect a dead broker by
+EOF/send-failure, never by pong absence.
 
 Delivery semantics (matching AMQP's, SURVEY.md §5 "Failure detection"):
 at-least-once.  A job is requeued when its worker disconnects or stops

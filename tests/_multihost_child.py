@@ -43,6 +43,32 @@ def build_workload():
     return x, y, genomes, config
 
 
+def build_small_cnn_workload():
+    """Single-stage Genetic-CNN workload for the worker-cnn e2e test.
+
+    The full ``build_workload`` supergraph costs minutes of XLA compile on
+    CPU *per process*; this one compiles in tens of seconds while still
+    exercising the identical code path (GentunClient → Population.evaluate
+    → sharded cross_validate_population over the global mesh).
+    """
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=64).astype(np.int32)
+    genomes = [{"S_1": tuple(int(b) for b in rng.integers(0, 2, 3))} for _ in range(4)]
+    config = dict(
+        nodes=(3,),
+        kernels_per_layer=(6,),
+        kfold=2,
+        epochs=(1,),
+        learning_rate=(0.05,),
+        batch_size=16,
+        dense_units=16,
+        compute_dtype="float32",
+        seed=0,
+    )
+    return x, y, genomes, config
+
+
 def run_cv(mesh):
     from gentun_tpu.models.cnn import GeneticCnnModel
 
@@ -109,7 +135,7 @@ def main() -> None:
             from gentun_tpu.individuals import GeneticCnnIndividual
 
             species = GeneticCnnIndividual
-            x, y, _, _ = build_workload()
+            x, y, _, _ = build_small_cnn_workload()
             data = (x, y)
             capacity = 4
         else:

@@ -999,3 +999,31 @@ class TestWorkerCliGuards:
                 "--species", "boosting", "--dataset", "uci-binary",
                 "--n", bad_n, "--max-jobs", "1",
             ])
+
+
+class TestFinalResultsNotLostOnExit:
+    """Regression (found by the multihost CNN e2e test): a worker exiting
+    right after its last batch used to close the socket with unread
+    broker frames in its receive buffer, turning close() into an RST that
+    destroyed the still-in-flight result frames.  Heartbeat replies are
+    gone and the clean-exit path now FIN-drains (``_graceful_close``), so
+    every result of the final batch must arrive."""
+
+    def test_worker_exit_after_final_batch_delivers_all_results(self):
+        with DistributedPopulation(
+            SlowOneMax, size=6, seed=2, port=0,
+            additional_parameters={"delay": 0.5}, job_timeout=60.0,
+        ) as pop:
+            _, port = pop.broker_address
+            # Tiny heartbeat interval: many pings pile up during the slow
+            # batch (the old pong replies would have sat unread); max_jobs
+            # makes the worker exit the instant the batch is replied.
+            worker = GentunClient(
+                SlowOneMax, *DATA, port=port, capacity=6,
+                heartbeat_interval=0.02, reconnect_delay=0.1,
+            )
+            t = threading.Thread(target=lambda: worker.work(max_jobs=6), daemon=True)
+            t.start()
+            assert pop.evaluate() == 6  # every result of the final batch arrived
+            t.join(timeout=10.0)
+            assert not t.is_alive()

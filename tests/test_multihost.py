@@ -200,19 +200,34 @@ def test_multihost_worker_real_cnn_matches_single_process(tmp_path):
     the worker must advertise the slice's full chip count."""
     sys.path.insert(0, os.path.dirname(CHILD))
     try:
-        from _multihost_child import build_workload
+        from _multihost_child import build_small_cnn_workload
     finally:
         sys.path.pop(0)
+    from gentun_tpu import GeneticCnnIndividual, Population
     from gentun_tpu.distributed import JobBroker
-    from gentun_tpu.models.cnn import GeneticCnnModel
 
-    x, y, genomes, config = build_workload()
-    # Single-process reference with the same default-mesh choice the worker
-    # makes (8 global devices in both worlds → identical program).
-    want = np.asarray(
-        GeneticCnnModel.cross_validate_population(x, y, genomes, **config),
-        dtype=np.float32,
+    x, y, genomes, config = build_small_cnn_workload()
+    # Share one persistent XLA cache between this process and the cluster
+    # children so they can load what the reference run compiled instead of
+    # recompiling under in-suite CPU contention.
+    config = dict(config, cache_dir=str(tmp_path / "xla-cache"))
+    # Reference = the SINGLE-PROCESS Population.evaluate path (exactly what
+    # the worker runs): this includes the canonical-architecture dedup, so
+    # an isomorphic pair in the genome set — deliberately present — must
+    # share one fitness on both sides.
+    ref_pop = Population(
+        GeneticCnnIndividual,
+        x_train=x,
+        y_train=y,
+        individual_list=[
+            GeneticCnnIndividual(x_train=x, y_train=y, genes=g,
+                                 additional_parameters=dict(config))
+            for g in genomes
+        ],
+        additional_parameters=dict(config),
     )
+    ref_pop.evaluate()
+    want = np.asarray([ind.get_fitness() for ind in ref_pop], dtype=np.float32)
 
     payloads = {
         f"cnn-{i}": {
@@ -223,21 +238,27 @@ def test_multihost_worker_real_cnn_matches_single_process(tmp_path):
         }
         for i, g in enumerate(genomes)
     }
-    broker = JobBroker(port=0).start()
+    # Long heartbeat: a contended compile can starve the leader's ping
+    # thread past the 15 s default, and a spurious mid-compile reap turns
+    # one slow evaluation into several.
+    broker = JobBroker(port=0, heartbeat_timeout=300.0).start()
     procs = []
     try:
         _, port = broker.address
         out_path = str(tmp_path / "cnn_worker.json")
         procs = _spawn_cluster("worker-cnn", out_path, extra_args=(port, len(payloads)))
+        # One logical worker spanning the whole 8-device slice advertises
+        # all of it in its hello (VERDICT r3 item 3 on the real species);
+        # check while it is connected — it disconnects after max_jobs.
+        deadline = time.monotonic() + 600.0
+        while broker.fleet_chips() != 8 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert broker.fleet_chips() == 8
         broker.submit(payloads)
-        # Generous: the children compile the CV program from scratch, and
-        # suite runs share the host CPU with other XLA compiles.
+        # Generous: suite runs share the host CPU with other XLA compiles.
         results = broker.gather(list(payloads), timeout=900.0)
         got = np.asarray([results[f"cnn-{i}"] for i in range(len(genomes))], dtype=np.float32)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
-        # One logical worker spanning the whole 8-device slice advertises
-        # all of it (VERDICT r3 item 3 exercised on the real species).
-        assert broker.fleet_chips() == 8
         _join(procs, timeout=120.0)
         with open(out_path + ".rank1") as f:
             assert json.load(f)["jobs_done"] == len(payloads)  # lockstep rank
