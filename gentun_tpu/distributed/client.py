@@ -304,6 +304,8 @@ class GentunClient:
             msg = self._recv()
             if msg["type"] == "jobs":
                 return list(msg["jobs"])
+            # "pong" is tolerated (silently) only for brokers predating
+            # the no-pong protocol; current brokers never send it.
             if msg["type"] not in ("pong", "welcome"):
                 logger.warning("unexpected message %r", msg["type"])
 

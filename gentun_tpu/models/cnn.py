@@ -49,6 +49,7 @@ TPU-first architecture (NOT how the reference does it — SURVEY.md §7
 from __future__ import annotations
 
 import functools
+import logging
 import weakref
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -67,6 +68,8 @@ from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
 from .generic import GentunModel
 
 __all__ = ["MaskedGeneticCnn", "GeneticCnnModel"]
+
+logger = logging.getLogger("gentun_tpu")
 
 
 class MaskedGeneticCnn(nn.Module):
@@ -568,6 +571,81 @@ def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarr
     return xd, yd
 
 
+#: Per-config cap on how many genomes one compiled program may carry,
+#: learned from device OOMs (see _chunked_by_cap).  Keyed by the shape-
+#: relevant config fingerprint so a memory-hungry deep config's cap never
+#: throttles a small config evaluated later in the same process.
+_POP_PROGRAM_CAP: Dict[Any, int] = {}
+
+
+def _oom_cap_key(cfg: Dict[str, Any]):
+    """Every config field that changes a program's per-genome memory —
+    configs differing in ANY of these must not share a learned cap."""
+    return (
+        tuple(cfg["nodes"]),
+        tuple(cfg["kernels_per_layer"]),
+        int(cfg["batch_size"]),
+        int(cfg["dense_units"]),
+        str(cfg["compute_dtype"]),
+        tuple(cfg["input_shape"]),
+        int(cfg["n_classes"]),
+        bool(cfg["fold_parallel"]),
+        cfg["segment_steps"],
+        int(cfg["kfold"]) if cfg.get("kfold") else None,
+    )
+
+
+def _is_oom_error(e: BaseException) -> bool:
+    s = str(e)
+    return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
+
+
+def _chunked_by_cap(run, genomes, cap_key):
+    """Run the batched evaluator, splitting the population on device OOM.
+
+    BASELINE config #5 (S=(5,5,5), 256 channels, pop=50) is sized for a
+    pod slice; vmapping all 50 genomes through one program exhausts a
+    single chip's HBM.  Instead of dying, split to a power-of-two chunk
+    (so the chunks reuse the standard bucket shapes — no compile churn)
+    and REMEMBER the cap for this config fingerprint: later generations
+    pre-chunk instead of re-discovering the OOM.  On a big mesh the pop
+    axis shards and no OOM ever happens, so the cap stays unset and
+    behavior is unchanged.
+    """
+    cap = _POP_PROGRAM_CAP.get(cap_key)
+    if cap is not None and len(genomes) > cap:
+        return np.concatenate(
+            [_chunked_by_cap(run, genomes[i : i + cap], cap_key)
+             for i in range(0, len(genomes), cap)]
+        )
+    try:
+        return run(genomes)
+    except Exception as e:
+        if not _is_oom_error(e) or len(genomes) <= 1:
+            raise
+        half = max(1, len(genomes) // 2)
+        b = 1
+        while b * 2 <= half:
+            b *= 2
+        _POP_PROGRAM_CAP[cap_key] = b
+        logger.warning(
+            "population batch of %d genomes exhausted device memory; "
+            "chunking to <=%d genomes per program (remembered for this "
+            "config in this process)", len(genomes), b,
+        )
+    # Retry OUTSIDE the except block, deliberately: the failed attempt's
+    # exception traceback pins the frames (and therefore the device
+    # buffers) of the too-large execution — recursing inside the handler
+    # chains those exceptions and accumulates dead HBM until even a
+    # 1-genome program cannot allocate (measured on the deep config).
+    # Leaving the handler drops the traceback; collect to free the
+    # buffers before the smaller chunks run.
+    import gc
+
+    gc.collect()
+    return _chunked_by_cap(run, genomes, cap_key)
+
+
 def _pop_bucket(n: int) -> int:
     """Round SMALL population batches up to a power of two (≤ 16).
 
@@ -749,8 +827,28 @@ class GeneticCnnModel(GentunModel):
 
         Returns an array of P mean validation accuracies.  All genomes train
         simultaneously: the population axis is vmapped, so XLA sees one
-        computation with P-wide batched convolutions.
+        computation with P-wide batched convolutions.  A population too
+        large for the device's memory (deep configs on few chips) is
+        chunked automatically, with the learned cap reused across
+        generations (``_chunked_by_cap``).
         """
+        if len(genomes) > 1:
+            cfg0 = _normalize_config(x_train, y_train, config)
+            return _chunked_by_cap(
+                lambda gs: cls._cross_validate_population_one(x_train, y_train, gs, **config),
+                list(genomes),
+                _oom_cap_key(cfg0),
+            )
+        return cls._cross_validate_population_one(x_train, y_train, genomes, **config)
+
+    @classmethod
+    def _cross_validate_population_one(
+        cls,
+        x_train,
+        y_train,
+        genomes: Sequence[Mapping[str, Any]],
+        **config,
+    ) -> np.ndarray:
         cfg = _normalize_config(x_train, y_train, config)
         x, y = _prepare_data(x_train, y_train, cfg)
         if len(genomes) == 0:
@@ -844,6 +942,25 @@ class GeneticCnnModel(GentunModel):
 
     @classmethod
     def train_and_score(
+        cls,
+        x_train,
+        y_train,
+        x_test,
+        y_test,
+        genomes: Sequence[Mapping[str, Any]],
+        **config,
+    ) -> np.ndarray:
+        if len(genomes) > 1:
+            cfg0 = _normalize_config(x_train, y_train, config)
+            return _chunked_by_cap(
+                lambda gs: cls._train_and_score_one(x_train, y_train, x_test, y_test, gs, **config),
+                list(genomes),
+                _oom_cap_key(cfg0),
+            )
+        return cls._train_and_score_one(x_train, y_train, x_test, y_test, genomes, **config)
+
+    @classmethod
+    def _train_and_score_one(
         cls,
         x_train,
         y_train,

@@ -117,9 +117,25 @@ def main() -> None:
     assert got == {"gen": 1, "payload": [1, 2, 3]}, got
 
     if mode == "cv":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         from gentun_tpu.parallel.mesh import auto_mesh
 
         mesh = auto_mesh(devices=jax.devices(), pop_axis=2, data_axis=4)
+        # ADVICE r3: re-placing a non-addressable global array under a
+        # DIFFERENT sharding must raise place()'s descriptive error, not
+        # numpy's obscure addressability failure.  Only reachable in a
+        # real multi-process cluster, so it is pinned here.
+        arr = multihost.place(
+            np.arange(16.0, dtype=np.float32).reshape(16, 1),
+            NamedSharding(mesh, P("pop", None)),
+        )
+        if not arr.is_fully_addressable:
+            try:
+                multihost.place(arr, NamedSharding(mesh, P("data", None)))
+                raise AssertionError("expected ValueError for non-addressable re-place")
+            except ValueError as e:
+                assert "non-fully-addressable" in str(e), e
         accs = run_cv(mesh)
         if multihost.is_leader():
             with open(out_path, "w") as f:

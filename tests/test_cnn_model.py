@@ -348,3 +348,85 @@ def test_eval_batch_size_properties():
     # the reviewer's unlucky case: fold 513 @ batch 128 wastes ≤ one batch
     eval_bs, nvp = _eval_batch_size(128, 513)
     assert nvp == 640 and eval_bs == 320
+
+
+class TestOomChunking:
+    """Deep configs (BASELINE #5) OOM a single chip when the whole
+    population vmaps through one program; the evaluator must self-heal by
+    chunking and remember the cap for the config."""
+
+    def _fake_oom_run(self, fail_above):
+        calls = []
+
+        def run(genomes):
+            calls.append(len(genomes))
+            if len(genomes) > fail_above:
+                raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating ...")
+            return np.asarray([float(sum(g["S_1"])) for g in genomes])
+
+        return run, calls
+
+    def test_splits_on_oom_and_remembers_cap(self):
+        from gentun_tpu.models import cnn as cnn_mod
+
+        key = ("test-cfg-a",)
+        cnn_mod._POP_PROGRAM_CAP.pop(key, None)
+        run, calls = self._fake_oom_run(fail_above=16)
+        genomes = [{"S_1": (1, 0, 1)} for _ in range(50)]
+        out = cnn_mod._chunked_by_cap(run, genomes, key)
+        assert out.shape == (50,) and (out == 2.0).all()
+        # one failed 50-wide attempt, then power-of-two chunks (16s + tail)
+        assert calls[0] == 50
+        assert all(c <= 16 for c in calls[1:])
+        assert cnn_mod._POP_PROGRAM_CAP[key] == 16
+        # second call pre-chunks without re-discovering the OOM
+        calls.clear()
+        out2 = cnn_mod._chunked_by_cap(run, genomes, key)
+        assert out2.shape == (50,) and 50 not in calls
+        cnn_mod._POP_PROGRAM_CAP.pop(key, None)
+
+    def test_non_oom_errors_propagate(self):
+        from gentun_tpu.models import cnn as cnn_mod
+
+        def run(genomes):
+            raise ValueError("bad genome")
+
+        with pytest.raises(ValueError, match="bad genome"):
+            cnn_mod._chunked_by_cap(run, [{"S_1": (1,)}] * 4, ("test-cfg-b",))
+        assert ("test-cfg-b",) not in cnn_mod._POP_PROGRAM_CAP
+
+    def test_single_genome_oom_reraises(self):
+        from gentun_tpu.models import cnn as cnn_mod
+
+        def run(genomes):
+            raise RuntimeError("RESOURCE_EXHAUSTED")
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            cnn_mod._chunked_by_cap(run, [{"S_1": (1,)}], ("test-cfg-c",))
+
+    def test_chunked_matches_manual_chunks_real_model(self, separable_data):
+        """A capped run must equal evaluating the same chunks directly.
+
+        (Chunked vs UNchunked equality is deliberately not asserted:
+        per-slot parameter init makes a genome's measured fitness depend
+        on its batch, like any bucket-size change — the fitness cache is
+        what gives a genome one stable measurement per search.)"""
+        from gentun_tpu.models import cnn as cnn_mod
+        from gentun_tpu.models.cnn import GeneticCnnModel
+
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 0)}, {"S_1": (0, 1, 1)}, {"S_1": (1, 1, 1)}]
+        cfg = dict(nodes=(3,), kernels_per_layer=(8,), dense_units=32,
+                   kfold=2, epochs=(1,), learning_rate=(0.05,),
+                   batch_size=32, compute_dtype="float32", seed=0)
+        want = np.concatenate([
+            np.asarray(GeneticCnnModel.cross_validate_population(x, y, genomes[:2], **cfg)),
+            np.asarray(GeneticCnnModel.cross_validate_population(x, y, genomes[2:], **cfg)),
+        ])
+        key = cnn_mod._oom_cap_key(cnn_mod._normalize_config(x, y, dict(cfg)))
+        cnn_mod._POP_PROGRAM_CAP[key] = 2  # force chunking: 2 + 1
+        try:
+            got = GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
+        finally:
+            cnn_mod._POP_PROGRAM_CAP.pop(key, None)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
