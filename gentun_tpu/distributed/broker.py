@@ -160,6 +160,10 @@ class JobBroker:
         self._cond = threading.Condition()
         self._results: Dict[str, float] = {}
         self._failures: Dict[str, str] = {}
+        # Running max of the fleet's advertised chip total, sampled whenever
+        # a result arrives (ADVICE r4: a worker that disconnects right after
+        # its final result must still count in the per-chip denominator).
+        self._chips_seen = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -395,6 +399,18 @@ class JobBroker:
         """
         return max(1, sum(w.n_chips for w in list(self._workers.values())))
 
+    def reset_chips_seen(self) -> None:
+        """Start a fresh per-sweep chip-count observation window."""
+        self._chips_seen = 0
+
+    def chips_seen(self) -> int:
+        """The sweep's per-chip denominator (≥1): max of the CURRENT fleet
+        chip total and any total observed at a result arrival since the last
+        :meth:`reset_chips_seen`.  Counts both a worker that delivered its
+        last result and disconnected before the end-of-sweep snapshot, and a
+        late-joining worker that hasn't delivered yet."""
+        return max(1, self._chips_seen, sum(w.n_chips for w in list(self._workers.values())))
+
     @staticmethod
     def new_job_id() -> str:
         return uuid.uuid4().hex
@@ -568,6 +584,9 @@ class JobBroker:
             logger.info("duplicate/stale result for %s dropped (redelivery race)", job_id)
             return
         del self._payloads[job_id]
+        self._chips_seen = max(
+            self._chips_seen, sum(wk.n_chips for wk in self._workers.values())
+        )
         with self._cond:
             self._results[job_id] = fitness
             self._cond.notify_all()

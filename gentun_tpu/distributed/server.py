@@ -184,15 +184,18 @@ class DistributedPopulation(Population):
             return 0
         stats = {"attempts": 0, "retries": 0, "penalized": 0}
         self.eval_stats = stats
+        self.broker.reset_chips_seen()
         completed = 0
         while True:
             stats["attempts"] += 1
             try:
                 done = completed + self._evaluate_once()
-                # Sampled at sweep end so late-joining workers count: the
-                # GA's logger divides the north-star metric by this instead
-                # of the master's (jax-less, always-1) local chip count.
-                stats["n_chips"] = self.broker.fleet_chips()
+                # chips_seen() = max(current fleet, sweep-long observation):
+                # a worker that exits right after its final result still
+                # counts, as does a late joiner.  The GA's logger divides the
+                # north-star metric by this instead of the master's
+                # (jax-less, always-1) local chip count.
+                stats["n_chips"] = self.broker.chips_seen()
                 return done
             except (JobFailed, GatherTimeout) as e:
                 completed += len(getattr(e, "partial", {}))
@@ -217,7 +220,7 @@ class DistributedPopulation(Population):
                         "unfinished individual(s) with fitness %.6g (%s)",
                         stats["attempts"], stats["penalized"], worst, e,
                     )
-                    stats["n_chips"] = self.broker.fleet_chips()
+                    stats["n_chips"] = self.broker.chips_seen()
                     return completed
                 raise
 

@@ -121,6 +121,11 @@ def main(argv=None) -> int:
                     help="override the advertised accelerator chip count "
                          "(default: jax.device_count() for jax species, 1 otherwise)")
     ap.add_argument("--max-jobs", type=int, default=None, help="exit after this many results")
+    ap.add_argument("--fitness-store", default=None,
+                    help="read-only cross-run fitness cache (utils/fitness_store.py "
+                         "JSON): jobs whose genes+config were measured by a prior "
+                         "run are answered without retraining.  Not available with "
+                         "--coordinator (multihost) — see GentunClient.")
     mh = ap.add_argument_group(
         "multi-host",
         "run ONE logical worker across a multi-process jax cluster (e.g. all "
@@ -143,6 +148,10 @@ def main(argv=None) -> int:
     if (args.num_processes is not None or args.process_id is not None) and args.coordinator is None:
         raise SystemExit("--num-processes/--process-id require --coordinator")
     multihost = args.coordinator is not None
+    if multihost and args.fitness_store:
+        raise SystemExit("--fitness-store is not supported with --coordinator "
+                         "(a store present on one host but not another would "
+                         "diverge the ranks' compiled programs)")
     if multihost:
         # Must happen before ANY jax backend init (so before evaluation);
         # after it, jax.devices() is the global pod-slice device list and
@@ -170,6 +179,7 @@ def main(argv=None) -> int:
         worker_id=args.worker_id,
         multihost=multihost,
         n_chips=args.n_chips,
+        fitness_store=args.fitness_store,
     )
     try:
         done = client.work(max_jobs=args.max_jobs)

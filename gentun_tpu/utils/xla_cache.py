@@ -35,6 +35,7 @@ __all__ = ["enable_compilation_cache", "default_cache_dir"]
 logger = logging.getLogger("gentun_tpu")
 
 _enabled_dir: Optional[str] = None
+_failed_dirs: set = set()  # dirs that failed makedirs — don't retry/re-warn
 
 
 def default_cache_dir() -> Optional[str]:
@@ -54,29 +55,39 @@ def default_cache_dir() -> Optional[str]:
     return os.path.join(os.path.expanduser("~"), ".cache", "gentun_tpu", "xla")
 
 
-def enable_compilation_cache(cache_dir: str) -> str:
+def enable_compilation_cache(cache_dir: str) -> Optional[str]:
     """Point jax's persistent compilation cache at ``cache_dir``.
 
     Idempotent; safe to call before or after jax backend init (the cache is
     consulted at compile time, not at backend-init time).  Returns the
-    directory so call sites can log it.
+    directory on success, or ``None`` when it could not be enabled (ADVICE
+    r4: callers must be able to tell the difference — and a failed dir must
+    not shadow a previously-enabled one, which stays active in jax).
     """
     global _enabled_dir
     cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
     if _enabled_dir == cache_dir:
         return cache_dir
+    if cache_dir in _failed_dirs:
+        return None
     try:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError as e:
         # On-by-default must not break environments with unwritable HOMEs
-        # (read-only containers, HOME=/nonexistent CI): degrade to no cache.
-        logger.warning(
-            "persistent XLA cache dir %s is unusable (%s); caching DISABLED "
-            "— set GENTUN_TPU_CACHE_DIR to a writable path or to 'off' to "
-            "silence this", cache_dir, e,
-        )
-        _enabled_dir = cache_dir  # don't retry (and re-warn) every call
-        return cache_dir
+        # (read-only containers, HOME=/nonexistent CI): degrade loudly.
+        _failed_dirs.add(cache_dir)  # don't retry (and re-warn) every call
+        if _enabled_dir is not None:
+            logger.warning(
+                "persistent XLA cache dir %s is unusable (%s); jax keeps "
+                "caching at the previously-enabled %s", cache_dir, e, _enabled_dir,
+            )
+        else:
+            logger.warning(
+                "persistent XLA cache dir %s is unusable (%s); caching DISABLED "
+                "— set GENTUN_TPU_CACHE_DIR to a writable path or to 'off' to "
+                "silence this", cache_dir, e,
+            )
+        return None
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -213,13 +213,31 @@ def main(argv=None) -> int:
                 f"{name} {m}: p={s['p_sign']:.3f}, mean Δ {s['mean']:+.4f}"
                 for name, m, s in notable
             )
+            # Phrase the direction from the MEASURED signs (ADVICE r4: a
+            # rerun where a significant cell favors the paper variant must
+            # not produce a self-contradicting doc).
+            if all(s["mean"] < 0 for _, _, s in notable):
+                direction = (
+                    "every nominally-significant cell leans against the "
+                    "paper variant, and it argues for the bare-sum default, "
+                    "not against it"
+                )
+            elif all(s["mean"] > 0 for _, _, s in notable):
+                direction = (
+                    "every nominally-significant cell leans toward the "
+                    "paper variant — direction without magnitude; rerun "
+                    "with more genomes/seeds before changing the default"
+                )
+            else:
+                direction = (
+                    "the nominally-significant cells disagree in sign — "
+                    "direction without magnitude either way"
+                )
             verdict += (
-                f"  Direction note: every cell leans against the paper "
-                f"variant, and the sign test is nominally significant for "
-                f"{details} — a consistent but practically-nil effect "
-                "(≲0.1pp); the CI rule, which weights magnitude, reads it "
-                "as no separation, and it argues for the bare-sum default, "
-                "not against it."
+                f"  Direction note: the sign test is nominally significant "
+                f"for {details} — a consistent but practically-nil effect "
+                f"(≲0.1pp); the CI rule, which weights magnitude, reads it "
+                f"as no separation, and {direction}."
             )
     lines += [
         "",

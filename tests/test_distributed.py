@@ -86,6 +86,7 @@ def _start_worker_thread(species, port, **kw):
             species, *DATA, host="127.0.0.1", port=port,
             password=kw.get("password"), capacity=kw.get("capacity", 1),
             heartbeat_interval=0.2, reconnect_delay=0.1,
+            fitness_store=kw.get("fitness_store"),
         ).work(stop_event=stop),
         daemon=True,
     )
@@ -851,6 +852,26 @@ class TestFleetChips:
             finally:
                 stop.set()
 
+    def test_worker_exiting_after_final_result_still_counts(self):
+        """ADVICE r4: the per-chip denominator must survive a worker that
+        delivers its last result and disconnects before the post-sweep
+        snapshot.  A --max-jobs worker exits the instant its results are
+        sent; with only the end-of-sweep fleet_chips() its 4 chips would
+        collapse to 1."""
+        with DistributedPopulation(OneMax, size=4, seed=0, port=0) as pop:
+            _, port = pop.broker_address
+            t = threading.Thread(
+                target=lambda: GentunClient(
+                    OneMax, *DATA, port=port, capacity=4, n_chips=4,
+                    heartbeat_interval=0.2, reconnect_delay=0.1,
+                ).work(max_jobs=4),
+                daemon=True,
+            )
+            t.start()
+            pop.evaluate()
+            t.join(timeout=10)  # worker already gone (or going)
+            assert pop.eval_stats["n_chips"] == 4
+
     def test_single_process_record_unchanged(self):
         """Non-distributed populations keep the local-chip denominator
         (whatever the already-initialized backend reports in this process —
@@ -894,6 +915,35 @@ class TestDistributedFitnessStore:
         ) as pop2:
             assert pop2.evaluate() == 0
             assert [ind.get_fitness() for ind in pop2] == fits
+
+    def test_worker_side_store_answers_without_training(self, tmp_path):
+        """VERDICT r4 item 7: a WORKER given --fitness-store answers repeated
+        jobs from the store instead of retraining.  The stored fitness is a
+        sentinel no real OneMax evaluation could produce, so the returned
+        value proves the store (not training) answered."""
+        from gentun_tpu.utils.fitness_store import save_fitness_cache
+
+        store = str(tmp_path / "worker.fitness.json")
+        probe = OneMax(genes={"S_1": (1, 0, 1, 1, 1, 1), "S_2": (0, 1, 0, 0, 0, 0)})
+        sentinel = 4242.5  # OneMax fitness is a bit count — can't be this
+        save_fitness_cache({probe.cache_key(): sentinel}, store)
+
+        # Master WITHOUT a store: reuse must happen on the worker side.
+        with DistributedPopulation(
+            OneMax, individual_list=[OneMax(genes=probe.get_genes())], port=0,
+        ) as pop:
+            _, port = pop.broker_address
+            stop, _ = _start_worker_thread(OneMax, port, fitness_store=store)
+            try:
+                assert pop.evaluate() == 1  # the job WAS shipped...
+                assert pop[0].get_fitness() == sentinel  # ...but not trained
+            finally:
+                stop.set()
+
+    def test_worker_store_refused_for_multihost(self, tmp_path):
+        with pytest.raises(ValueError, match="multihost"):
+            GentunClient(OneMax, *DATA, multihost=True,
+                         fitness_store=str(tmp_path / "x.json"))
 
     def test_in_memory_measurement_beats_stored_value(self, tmp_path):
         from gentun_tpu.utils.fitness_store import save_fitness_cache

@@ -95,8 +95,26 @@ class TestCacheOptOutAndDegrade:
         from gentun_tpu.utils import xla_cache
 
         with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
-            xla_cache.enable_compilation_cache("/proc/definitely/not/writable-x")
-        assert any("caching DISABLED" in r.message for r in caplog.records)
+            # Failure is distinguishable from success (ADVICE r4): None back.
+            assert xla_cache.enable_compilation_cache("/proc/definitely/not/writable-x") is None
+        # The warning names the actual outcome: DISABLED when nothing was
+        # ever enabled, or the still-active previously-enabled dir (other
+        # tests in this process may have enabled one).
+        assert any(
+            "caching DISABLED" in r.message or "previously-enabled" in r.message
+            for r in caplog.records
+        )
+
+    def test_failed_dir_does_not_shadow_enabled_dir(self, tmp_path):
+        from gentun_tpu.utils import xla_cache
+
+        good = str(tmp_path / "good")
+        assert xla_cache.enable_compilation_cache(good) == os.path.abspath(good)
+        assert xla_cache.enable_compilation_cache("/proc/definitely/not/writable-y") is None
+        # The enabled dir survives the failed call — and re-enabling it is
+        # still recognized as already-active.
+        assert xla_cache._enabled_dir == os.path.abspath(good)
+        assert xla_cache.enable_compilation_cache(good) == os.path.abspath(good)
 
     def test_cache_dir_false_is_programmatic_opt_out(self, monkeypatch):
         import jax
