@@ -401,7 +401,8 @@ class JobBroker:
 
     def reset_chips_seen(self) -> None:
         """Start a fresh per-sweep chip-count observation window."""
-        self._chips_seen = 0
+        with self._cond:
+            self._chips_seen = 0
 
     def chips_seen(self) -> int:
         """The sweep's per-chip denominator (≥1): max of the CURRENT fleet
@@ -409,7 +410,8 @@ class JobBroker:
         :meth:`reset_chips_seen`.  Counts both a worker that delivered its
         last result and disconnected before the end-of-sweep snapshot, and a
         late-joining worker that hasn't delivered yet."""
-        return max(1, self._chips_seen, sum(w.n_chips for w in list(self._workers.values())))
+        with self._cond:
+            return max(self._chips_seen, self.fleet_chips())
 
     @staticmethod
     def new_job_id() -> str:
@@ -584,10 +586,11 @@ class JobBroker:
             logger.info("duplicate/stale result for %s dropped (redelivery race)", job_id)
             return
         del self._payloads[job_id]
-        self._chips_seen = max(
-            self._chips_seen, sum(wk.n_chips for wk in self._workers.values())
-        )
         with self._cond:
+            # Under _cond: reset_chips_seen()/chips_seen() run on the master
+            # thread, and an unsynchronized read-modify-write here could
+            # resurrect a pre-reset total into the next sweep.
+            self._chips_seen = max(self._chips_seen, self.fleet_chips())
             self._results[job_id] = fitness
             self._cond.notify_all()
 

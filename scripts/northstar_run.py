@@ -105,6 +105,12 @@ def run_master(args) -> None:
             pop = self.population
             super().evolve_population()
             self._capture(pop)  # the JUST-evaluated generation (super() replaced it)
+            # Flush progress every generation: a crash at generation 49 of a
+            # wall-hours run must not lose the 48 before it.
+            with open(args.out + ".partial", "w") as f:
+                json.dump({"generations_done": self.generation,
+                           "distinct_architectures": len(self.seen),
+                           "history": self.history}, f, indent=1)
 
     record = {
         "workload": "north-star 20x50 full-schedule distributed genetic-cnn search "
@@ -134,10 +140,16 @@ def run_master(args) -> None:
         port=args.port,
         job_timeout=args.job_timeout,
         evaluate_retries=3,
+        # A straggler that still fails after 4 passes gets the generation's
+        # worst fitness instead of killing the whole wall-hours search.
+        failed_policy="penalize",
         fitness_store=args.fitness_store or None,
     ) as pop:
         print(f"broker listening on {pop.broker_address}; waiting for a worker", flush=True)
+        from gentun_tpu.utils.checkpoint import Checkpointer
+
         ga = NorthStarGA(pop, seed=0)
+        ga.set_checkpointer(Checkpointer(args.out + ".ckpt"))  # resume point
         t0 = time.monotonic()
         # ga.run(generations) inlined so the final post-loop evaluation's
         # training count is recorded too (run() doesn't log it to history).

@@ -233,11 +233,13 @@ def main(argv=None) -> int:
                     "the nominally-significant cells disagree in sign — "
                     "direction without magnitude either way"
                 )
+            # Magnitude from the data, not a hardcoded claim.
+            max_pp = max(abs(s["mean"]) for _, _, s in notable) * 100.0
             verdict += (
                 f"  Direction note: the sign test is nominally significant "
-                f"for {details} — a consistent but practically-nil effect "
-                f"(≲0.1pp); the CI rule, which weights magnitude, reads it "
-                f"as no separation, and {direction}."
+                f"for {details} — a consistent effect of at most "
+                f"{max_pp:.2f}pp; the CI rule, which weights magnitude, "
+                f"reads it as no separation, and {direction}."
             )
     lines += [
         "",
