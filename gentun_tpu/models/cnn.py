@@ -783,6 +783,7 @@ class GeneticCnnModel(GentunModel):
         stage_exit_conv: bool = False,
         segment_steps: Optional[int] = 96,
         pop_padding: bool = True,
+        fitness_reps: int = 1,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -806,6 +807,7 @@ class GeneticCnnModel(GentunModel):
             stage_exit_conv=bool(stage_exit_conv),
             segment_steps=segment_steps,
             pop_padding=bool(pop_padding),
+            fitness_reps=int(fitness_reps),
         )
 
     def cross_validate(self) -> float:
@@ -832,6 +834,30 @@ class GeneticCnnModel(GentunModel):
         chunked automatically, with the learned cap reused across
         generations (``_chunked_by_cap``).
         """
+        reps_raw = config.get("fitness_reps", 1)
+        reps = 1 if reps_raw is None else int(reps_raw)
+        # reps < 1 falls through to _normalize_config, which raises.
+        if reps > 1:
+            # Noise-reduced fitness (VERDICT r4 weak #1): average each
+            # genome's CV accuracy over `reps` fully independent trainings,
+            # one call per rep with a derived seed.  Each rep differs in
+            # init, dropout, shuffle order AND fold assignment — the same
+            # independence the holdout estimator uses — and the derived
+            # seed only changes input arrays (index tables, PRNG keys), so
+            # all reps share one compiled program.  Deliberately NOT
+            # implemented by tiling reps into the population axis: the
+            # learned OOM cap (`_chunked_by_cap`) can split a tiled batch
+            # into position-aligned chunks whose copies would train
+            # bit-identically, silently averaging away nothing.
+            inner = {**config, "fitness_reps": 1}
+            base_seed = int(config.get("seed", 0) or 0)
+            per_rep = [
+                cls.cross_validate_population(
+                    x_train, y_train, genomes, **{**inner, "seed": base_seed + 7919 * r}
+                )
+                for r in range(reps)
+            ]
+            return np.mean(per_rep, axis=0, dtype=np.float64).astype(np.float32)
         if len(genomes) > 1:
             cfg0 = _normalize_config(x_train, y_train, config)
             return _chunked_by_cap(
@@ -950,6 +976,23 @@ class GeneticCnnModel(GentunModel):
         genomes: Sequence[Mapping[str, Any]],
         **config,
     ) -> np.ndarray:
+        reps_raw = config.get("fitness_reps", 1)
+        reps = 1 if reps_raw is None else int(reps_raw)
+        # reps < 1 falls through to _normalize_config, which raises.
+        if reps > 1:
+            # Same per-rep derived-seed protocol as
+            # cross_validate_population: mean holdout accuracy over `reps`
+            # fully independent trainings.
+            inner = {**config, "fitness_reps": 1}
+            base_seed = int(config.get("seed", 0) or 0)
+            per_rep = [
+                cls.train_and_score(
+                    x_train, y_train, x_test, y_test, genomes,
+                    **{**inner, "seed": base_seed + 7919 * r},
+                )
+                for r in range(reps)
+            ]
+            return np.mean(per_rep, axis=0, dtype=np.float64).astype(np.float32)
         if len(genomes) > 1:
             cfg0 = _normalize_config(x_train, y_train, config)
             return _chunked_by_cap(
@@ -1043,6 +1086,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         stage_exit_conv=False,
         segment_steps=96,
         pop_padding=True,
+        fitness_reps=1,
     )
     unknown = set(config) - set(defaults)
     if unknown:
@@ -1060,6 +1104,9 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         cfg["segment_steps"] = int(cfg["segment_steps"])
         if cfg["segment_steps"] < 1:
             raise ValueError("segment_steps must be a positive int or None")
+    cfg["fitness_reps"] = 1 if cfg["fitness_reps"] is None else int(cfg["fitness_reps"])
+    if cfg["fitness_reps"] < 1:
+        raise ValueError("fitness_reps must be a positive int")
     x = np.asarray(x_train)
     if cfg["input_shape"] is None:
         if x.ndim == 4:

@@ -58,6 +58,14 @@ from gentun_tpu.utils.stats import fmt_paired, paired_row  # noqa: E402
 #: history of this script).
 NODES = (3, 4, 5)
 
+#: Trainings averaged into each fitness evaluation (VERDICT r4 weak #1:
+#: the r4 run's own analysis blamed single-training fitness noise —
+#: CV-optimism +0.05 vs random — for the unresolved holdout transfer, and
+#: named multi-seed averaging as the untried fix).  Set from
+#: --fitness-reps in main(); each rep is a full independent training at a
+#: derived seed (models/cnn.py fitness_reps), sharing one compiled program.
+FITNESS_REPS = 3
+
 
 def model_params(seed: int) -> dict:
     """Tight-capacity training config: architecture has to earn its accuracy.
@@ -76,6 +84,7 @@ def model_params(seed: int) -> dict:
         batch_size=64,
         dropout_rate=0.3,
         seed=seed,
+        fitness_reps=FITNESS_REPS,
     )
 
 
@@ -203,6 +212,9 @@ def holdout_score(genes, x, y, x_te, y_te, seed: int, reps: int = 3) -> float:
     for r in range(reps):
         p = model_params(seed)
         p["seed"] = 1000 + 101 * seed + r
+        # The holdout estimator keeps its own explicit multi-seed loop
+        # (distinct shuffle orders per rep, not just distinct inits).
+        p["fitness_reps"] = 1
         accs.append(float(GeneticCnnModel.train_and_score(x, y, x_te, y_te, [genes], **p)[0]))
     return float(np.mean(accs))
 
@@ -216,6 +228,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seeds", type=int, nargs="+", default=list(range(10)))
     ap.add_argument("--n-train", type=int, default=700)
     ap.add_argument("--n-test", type=int, default=400)
+    ap.add_argument("--fitness-reps", type=int, default=3,
+                    help="independent trainings averaged into each fitness "
+                         "evaluation (the r5 noise-reduced protocol; 1 "
+                         "reproduces the r4 single-training protocol)")
     ap.add_argument("--out", default=None, help="output markdown path (default: repo SEARCH.md)")
     ap.add_argument("--analyze-only", action="store_true",
                     help="recompute SEARCH.md (incl. paired statistics) from "
@@ -236,6 +252,12 @@ def main(argv=None) -> int:
         write_markdown(results, out_md, saved)
         print(f"wrote {out_md} (analysis of existing sidecar)")
         return 0
+
+    global FITNESS_REPS
+    FITNESS_REPS = max(1, int(args.fitness_reps))
+    # The artifact must record the protocol that RAN, not the raw flag
+    # (--fitness-reps 0 clamps to 1; vars(args) feeds results["config"]).
+    args.fitness_reps = FITNESS_REPS
 
     # One dataset for everyone; a disjoint holdout scores the winners.
     x_all, y_all, meta = load_mnist(n=args.n_train + args.n_test, seed=123)
@@ -307,8 +329,12 @@ def write_markdown(results: dict, out_md: str, args) -> None:
         f"(≈{sum(k * (k - 1) // 2 for k in NODES) * MUTATION_RATE:.1f} "
         "expected flips/child),",
         f"tournament size {TOURNAMENT_SIZE}; the library defaults keep the",
-        "reference-parity values (0.015, 5).  Full curves:",
-        "`scripts/search_efficacy.json`;",
+        "reference-parity values (0.015, 5).",
+        f"Fitness protocol: each evaluation averages "
+        f"{results['config'].get('fitness_reps', 1)} independent training(s)"
+        " (models/cnn.py `fitness_reps` — the r5 noise-reduced protocol;"
+        " r4 used 1 and its CV-optimism analysis motivated the change).",
+        "Full curves: `scripts/search_efficacy.json`;",
         "reproduce: `python scripts/search_efficacy.py`.",
         "",
         "## Best CV fitness vs budget (mean ± spread over seeds "

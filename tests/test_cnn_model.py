@@ -186,6 +186,63 @@ class TestGeneticCnnModelCV:
         assert 0.0 <= m.cross_validate() <= 1.0
 
 
+class TestFitnessReps:
+    """fitness_reps=R (VERDICT r4 weak #1): per-evaluation fitness averaged
+    over R independent trainings, tiled through the population vmap axis."""
+
+    def test_reps_shape_and_agreement_with_per_seed_calls(self, separable_data):
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (0, 1, 1)}]
+        accs = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, fitness_reps=2, **FAST
+        )
+        assert accs.shape == (2,)
+        assert np.isfinite(accs).all() and (accs > 0.3).all()
+        # Each rep is one full run at a derived seed: the average must
+        # reproduce the mean of the explicit per-seed calls exactly.
+        base = FAST["seed"]
+        per_seed = [
+            GeneticCnnModel.cross_validate_population(
+                x, y, genomes, **{**FAST, "seed": base + 7919 * r}
+            )
+            for r in range(2)
+        ]
+        np.testing.assert_allclose(accs, np.mean(per_seed, axis=0), rtol=1e-6)
+
+    def test_reps_are_independent_trainings(self, separable_data):
+        """The derived-seed reps must not be bit-identical replays (they
+        vary init, dropout, shuffle and folds), or averaging would remove
+        nothing — this is the failure mode that sank the earlier pop-axis
+        tiling design under the learned OOM chunk cap."""
+        x, y = separable_data
+        base = FAST["seed"]
+        r0, r1 = (
+            GeneticCnnModel.cross_validate_population(
+                x, y, [{"S_1": (1, 0, 1)}], **{**FAST, "seed": base + 7919 * r}
+            )[0]
+            for r in range(2)
+        )
+        assert r0 != r1, (r0, r1)
+
+    def test_reps_validation_and_instance_path(self, separable_data):
+        x, y = separable_data
+        with pytest.raises(ValueError):
+            GeneticCnnModel.cross_validate_population(
+                x, y, [{"S_1": (1, 0, 1)}], fitness_reps=0, **FAST
+            )
+        m = GeneticCnnModel(x, y, {"S_1": (1, 0, 1)}, fitness_reps=2, **FAST)
+        assert 0.4 < m.cross_validate() <= 1.0
+
+    def test_train_and_score_reps(self, separable_data):
+        x, y = separable_data
+        accs = GeneticCnnModel.train_and_score(
+            x[:128], y[:128], x[128:], y[128:], [{"S_1": (1, 0, 1)}],
+            fitness_reps=2, **FAST
+        )
+        assert accs.shape == (1,)
+        assert 0.0 <= accs[0] <= 1.0
+
+
 class TestStageExitConv:
     """Optional Xie & Yuille output-node conv (ADVICE r1, cnn.py stage exit)."""
 
