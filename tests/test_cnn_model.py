@@ -243,6 +243,38 @@ class TestFitnessReps:
         assert 0.0 <= accs[0] <= 1.0
 
 
+class TestEntryChannelPad:
+    """entry_channel_pad (VERDICT r4 item 5): zero-pad input channels at
+    data-prep level so the entry conv kernel lands on lane-aligned shapes;
+    all-zero channels contribute nothing to the conv outputs."""
+
+    def test_padded_run_learns_and_shapes_flow(self, separable_data):
+        x, y = separable_data  # 1-channel 8x8
+        accs = GeneticCnnModel.cross_validate_population(
+            x, y, [{"S_1": (1, 0, 1)}], entry_channel_pad=8, **FAST
+        )
+        assert accs.shape == (1,)
+        assert 0.4 < accs[0] <= 1.0
+
+    def test_flat_input_reshapes_with_raw_shape_then_pads(self, separable_data):
+        x, y = separable_data
+        flat = x.reshape(x.shape[0], -1)
+        m = GeneticCnnModel(
+            flat, y, {"S_1": (1, 0, 1)}, input_shape=(8, 8, 1),
+            entry_channel_pad=4, **FAST
+        )
+        assert 0.4 < m.cross_validate() <= 1.0
+
+    def test_pad_no_op_when_channels_already_enough(self, separable_data):
+        from gentun_tpu.models.cnn import _normalize_config
+
+        x, y = separable_data
+        cfg = _normalize_config(x, y, dict(entry_channel_pad=1))
+        assert cfg["input_shape"] == (8, 8, 1)  # pad below C: unchanged
+        with pytest.raises(ValueError):
+            _normalize_config(x, y, dict(entry_channel_pad=0))
+
+
 class TestStageExitConv:
     """Optional Xie & Yuille output-node conv (ADVICE r1, cnn.py stage exit)."""
 

@@ -1,0 +1,149 @@
+"""Speculative bucket filling (VERDICT r4 weak #2, the tail-generation
+throughput mitigation): small evaluation batches pad to the compile-shape
+bucket anyway, so the padding slots carry mutated copies of the elite whose
+fitnesses warm the cache for future generations."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from gentun_tpu.distributed import DistributedPopulation, GentunClient
+from gentun_tpu.genes import genetic_cnn_genome
+from gentun_tpu.individuals import Individual
+from gentun_tpu.populations import Population, _compile_bucket
+
+
+class OneMax(Individual):
+    def build_spec(self, **p):
+        return genetic_cnn_genome(tuple(p.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+def test_compile_bucket_matches_model_pop_bucket():
+    """populations._compile_bucket is a deliberate jax-free mirror of
+    models/cnn._pop_bucket — they must stay in lockstep."""
+    from gentun_tpu.models.cnn import _pop_bucket
+
+    for n in range(1, 40):
+        assert _compile_bucket(n) == _pop_bucket(n), n
+
+
+def test_speculative_individuals_are_fresh_elite_mutants():
+    pop = Population(OneMax, *DATA, size=6, seed=3, speculative_fill=True)
+    pop.evaluate()
+    exclude = set()
+    spec = pop._speculative_individuals(3, exclude)
+    assert 0 < len(spec) <= 3
+    keys = {pop._safe_cache_key(s) for s in spec}
+    assert len(keys) == len(spec)  # mutually distinct
+    for s in spec:
+        assert not s.fitness_evaluated  # fresh, unevaluated
+        assert pop._safe_cache_key(s) not in pop.fitness_cache
+
+    # No evaluated member yet ⇒ no speculation (generation 0).
+    pop0 = Population(OneMax, *DATA, size=4, seed=1, speculative_fill=True)
+    assert pop0._speculative_individuals(3, set()) == []
+
+
+def test_fill_target_modes():
+    pop_free = Population(OneMax, *DATA, size=2, seed=0, speculative_fill=True)
+    assert pop_free._fill_target(3) == 4  # free mode: just the bucket
+    assert pop_free._fill_target(2) == 2  # 2 is an exact bucket: no slots
+    pop_agg = Population(OneMax, *DATA, size=2, seed=0, speculative_fill=8)
+    assert pop_agg._fill_target(2) == 8  # int mode raises the target
+    assert pop_agg._fill_target(20) == 20  # big batches unaffected
+
+
+def test_distributed_small_sweep_ships_speculative_jobs_cache_only():
+    """A 2-individual sweep on a speculative(4) population ships extra jobs
+    up to the 4-batch; their results land in the cache, not the population,
+    and the returned trained count stays the REAL count."""
+    with DistributedPopulation(
+        OneMax, size=6, seed=5, port=0, speculative_fill=4,
+    ) as pop:
+        _, port = pop.broker_address
+        stop = threading.Event()
+        threading.Thread(
+            target=lambda: GentunClient(
+                OneMax, *DATA, port=port, capacity=8,
+                heartbeat_interval=0.2, reconnect_delay=0.1,
+            ).work(stop_event=stop),
+            daemon=True,
+        ).start()
+        try:
+            assert pop.evaluate() == 6  # generation 0: full, no speculation
+            cache_after_g0 = len(pop.fitness_cache)
+
+            # A tail generation: 2 fresh children pending.
+            child_a = pop[0].copy(genes=pop[0].get_genes()).mutate(pop.rng)
+            child_b = pop[1].copy(genes=pop[1].get_genes()).mutate(pop.rng)
+            while pop._safe_cache_key(child_a) in pop.fitness_cache:
+                child_a.mutate(pop.rng)
+            while (
+                pop._safe_cache_key(child_b) in pop.fitness_cache
+                or pop._safe_cache_key(child_b) == pop._safe_cache_key(child_a)
+            ):
+                child_b.mutate(pop.rng)
+            tail = pop.clone_with([*list(pop)[:4], child_a, child_b])
+            assert tail.speculative_fill  # rides clone_with
+            trained = tail.evaluate()
+            assert trained == 2  # speculative jobs excluded from the count
+            # Bucket for 2 real jobs is 4 ⇒ up to 2 speculative results
+            # beyond the two children landed in the shared cache.
+            new_entries = len(tail.fitness_cache) - cache_after_g0
+            assert new_entries >= 3, new_entries  # 2 children + ≥1 speculative
+            for ind in tail:
+                assert ind.fitness_evaluated
+        finally:
+            stop.set()
+
+
+def test_incomplete_speculative_jobs_never_raise():
+    """A speculative job that never completes (worker gone, failed, or
+    straggling) is ignored — the generation barrier covers real jobs only."""
+    with DistributedPopulation(
+        OneMax, size=2, seed=0, port=0, speculative_fill=4,
+    ) as pop:
+        pop._spec_job_ids = {"spec-job-that-never-ran"}
+        pop._collect_speculative({}, timeout=0.0)  # must not raise
+        # And a real sweep afterwards is unaffected:
+        _, port = pop.broker_address
+        stop, _t = _start_worker(port)
+        try:
+            assert pop.evaluate() == 2
+        finally:
+            stop.set()
+
+
+def _start_worker(port):
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: GentunClient(
+            OneMax, *DATA, port=port, capacity=8,
+            heartbeat_interval=0.2, reconnect_delay=0.1,
+        ).work(stop_event=stop),
+        daemon=True,
+    )
+    t.start()
+    return stop, t
+
+
+def test_local_population_speculation_fills_cache():
+    pop = Population(OneMax, *DATA, size=8, seed=7, speculative_fill=True)
+    pop.evaluate()
+    n0 = len(pop.fitness_cache)
+    child = pop[0].copy(genes=pop[0].get_genes()).mutate(pop.rng)
+    while pop._safe_cache_key(child) in pop.fitness_cache:
+        child.mutate(pop.rng)
+    tail = pop.clone_with([*list(pop)[:7], child])
+    trained = tail.evaluate()
+    assert trained == 1
+    # OneMax has no batched model path ⇒ sequential fallback skips
+    # speculation entirely: exactly the one child was measured.
+    assert len(tail.fitness_cache) == n0 + 1
