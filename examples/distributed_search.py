@@ -65,6 +65,10 @@ def run_master(args):
         # Cross-run reuse: architectures measured by ANY previous search
         # against this store are answered from the file and never reshipped.
         fitness_store=args.fitness_store or None,
+        # Tail-generation throughput: fill compile-bucket padding slots
+        # with speculative elite mutants whose fitnesses warm the cache
+        # (strictly free — the slots would train discarded dummies).
+        speculative_fill=args.speculative_fill,
     ) as pop:
         print(f"broker listening on port {pop.broker_address[1]}; waiting for workers")
         best = GeneticAlgorithm(pop, seed=0).run(args.generations)
@@ -133,6 +137,9 @@ def main(argv=None):
     m.add_argument("--generations", type=int, default=50)
     m.add_argument("--fitness-store", default="",
                    help="cross-run fitness store path (utils/fitness_store.py)")
+    m.add_argument("--speculative-fill", action="store_true",
+                   help="fill compile-bucket padding slots with speculative "
+                        "elite mutants (free tail-generation cache warm-up)")
     w = sub.add_parser("worker")
     w.add_argument("--host", default="127.0.0.1")
     w.add_argument("--port", type=int, default=5672)

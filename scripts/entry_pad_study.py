@@ -20,7 +20,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -30,15 +29,14 @@ import bench  # the bench workload IS the comparison baseline  # noqa: E402
 
 
 def timed(x, y, cfg, pop, reps=2):
-    from gentun_tpu.models.cnn import GeneticCnnModel
-
-    genomes = bench.random_population(pop, seed=2)
-    GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)  # warmup/compile
+    """bench.timed_run's exact workload (same genomes, same timing fence),
+    warmup + median-of-reps like bench.main — reused, not re-implemented,
+    so this study can never drift from the baseline it compares against."""
+    bench.timed_run(x, y, cfg, pop)  # warmup/compile
     walls, accs = [], None
     for _ in range(reps):
-        t0 = time.monotonic()
-        accs = GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
-        walls.append(time.monotonic() - t0)
+        accs, wall = bench.timed_run(x, y, cfg, pop)
+        walls.append(wall)
     return np.asarray(accs), float(np.median(walls))
 
 
@@ -65,6 +63,12 @@ def main(argv=None) -> int:
     }
     variants = [("unpadded", dict(bench.FULL))]
     variants += [(f"pad{p}", dict(bench.FULL, entry_channel_pad=p)) for p in args.pads]
+    def flush():
+        # Incremental: a failed later variant must not discard the chip
+        # minutes already measured.
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+
     for name, cfg in variants:
         accs, wall = timed(x, y, cfg, bench.POP, reps=args.reps)
         rate = bench.POP / wall * 3600.0 / n_chips
@@ -74,7 +78,9 @@ def main(argv=None) -> int:
             "individuals_per_hour_per_chip": round(rate, 2),
             "mfu_useful": round(mfu, 4),
             "accuracy_mean": round(float(accs.mean()), 4),
+            "accuracy_gate_0.9": bool(accs.mean() > 0.9),
         }
+        flush()
         print(f"[{name}] wall={wall:.1f}s rate={rate:.1f}/hr/chip "
               f"mfu={mfu:.4f} acc={accs.mean():.4f}", flush=True)
         assert accs.mean() > 0.9, f"{name}: accuracy gate failed ({accs.mean():.3f})"
@@ -95,8 +101,7 @@ def main(argv=None) -> int:
     for name, v in record["variants"].items():
         if "individuals_per_hour_per_chip" in v and not name.startswith("proxy"):
             v["vs_unpadded"] = round(v["individuals_per_hour_per_chip"] / base, 4)
-    with open(args.out, "w") as f:
-        json.dump(record, f, indent=1)
+    flush()
     print(f"wrote {args.out}")
     return 0
 
