@@ -388,6 +388,7 @@ class DistributedPopulation(Population):
         # clones closing in any order is safe.  Externally-provided brokers
         # (broker= at construction) are never owned and never stopped here.
         clone._owns_broker = self._owns_broker
+        self._carry_spec_rng(clone)
         return clone
 
 

@@ -241,6 +241,14 @@ class Population:
         parent = max(evaluated, key=key_fn) if self.maximize else min(evaluated, key=key_fn)
         if template is None:
             template = parent
+        # Speculation must NOT perturb the search: drawing mutants from
+        # self.rng would shift every subsequent selection/reproduction draw,
+        # making a speculative run a different search from a non-speculative
+        # one under the same seed.  A dedicated deterministic stream keeps
+        # trajectories identical with the feature on or off.
+        spec_rng = getattr(self, "_spec_rng", None)
+        if spec_rng is None:
+            spec_rng = self._spec_rng = np.random.default_rng(0x5BEC)
         # The mutate-until-changed loop compares against the parent's GENES
         # under the template's params, so cross-group gene seeding works.
         base_key = self._safe_cache_key(template.copy(genes=parent.get_genes()))
@@ -254,7 +262,7 @@ class Population:
             # changes (bounded — a rate of 0 must not spin forever).
             key = None
             for _ in range(32):
-                child.mutate(self.rng)
+                child.mutate(spec_rng)
                 key = self._safe_cache_key(child)
                 if key is not None and key != base_key:
                     break
@@ -401,7 +409,7 @@ class Population:
         ``GridPopulation`` deliberately degrades to a plain ``Population``:
         grid enumeration only describes generation zero.
         """
-        return Population(
+        clone = Population(
             species=self.species,
             x_train=self.x_train,
             y_train=self.y_train,
@@ -414,6 +422,17 @@ class Population:
             fitness_cache=self.fitness_cache,
             speculative_fill=self.speculative_fill,
         )
+        self._carry_spec_rng(clone)
+        return clone
+
+    def _carry_spec_rng(self, clone: "Population") -> None:
+        """Carry the speculative RNG stream across generations (like
+        fitness_cache): re-seeding each clone would replay already-cached
+        elite mutants until the bounded attempt budget starves and
+        speculation silently stops filling slots."""
+        spec_rng = getattr(self, "_spec_rng", None)
+        if spec_rng is not None:
+            clone._spec_rng = spec_rng
 
     def get_fittest(self) -> Individual:
         """Best individual under the population's direction (evaluating lazily)."""

@@ -104,6 +104,26 @@ def test_distributed_small_sweep_ships_speculative_jobs_cache_only():
             stop.set()
 
 
+def test_spec_rng_is_isolated_and_carried_across_generations():
+    """(a) Speculation must not perturb the search stream: two identical-seed
+    populations, one speculating, draw identical reproduction randomness.
+    (b) The speculative stream rides clone_with — a re-seeded stream would
+    replay already-cached mutants until the attempt budget starves."""
+    pop = Population(OneMax, *DATA, size=6, seed=9, speculative_fill=True)
+    ref = Population(OneMax, *DATA, size=6, seed=9, speculative_fill=False)
+    pop.evaluate(); ref.evaluate()
+    pop._speculative_individuals(3, set())  # consumes ONLY the spec stream
+    assert pop.rng.bit_generator.state == ref.rng.bit_generator.state
+
+    # (b) the stream object itself is carried forward
+    rng_obj = pop._spec_rng
+    clone = pop.clone_with([i.copy() for i in pop])
+    assert clone._spec_rng is rng_obj
+    # and a generation later it still produces FRESH mutants (not replays)
+    spec2 = clone._speculative_individuals(3, set())
+    assert spec2, "carried stream should keep yielding uncached mutants"
+
+
 def test_incomplete_speculative_jobs_never_raise():
     """A speculative job that never completes (worker gone, failed, or
     straggling) is ignored — the generation barrier covers real jobs only."""
