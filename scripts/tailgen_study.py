@@ -57,16 +57,23 @@ def run_variant(name: str, spec_flag: str, args, port: int) -> dict:
     t0 = time.monotonic()
     master = subprocess.Popen(master_cmd, cwd=REPO, env=env,
                               stdout=master_log, stderr=subprocess.STDOUT)
-    time.sleep(3)
-    worker = subprocess.Popen(worker_cmd, cwd=REPO, env=env,
-                              stdout=worker_log, stderr=subprocess.STDOUT)
-    rc = master.wait(timeout=args.timeout)
-    worker.terminate()
+    worker = None
     try:
-        worker.wait(timeout=30)
-    except subprocess.TimeoutExpired:
-        worker.kill()
-    master_log.close(); worker_log.close()
+        time.sleep(3)
+        worker = subprocess.Popen(worker_cmd, cwd=REPO, env=env,
+                                  stdout=worker_log, stderr=subprocess.STDOUT)
+        rc = master.wait(timeout=args.timeout)
+    finally:
+        # A hung variant must not leak the master/worker pair: they own the
+        # TPU (one-TPU-process rule) and would block every later run.
+        for proc in (master, worker):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        master_log.close(); worker_log.close()
     if rc != 0:
         raise RuntimeError(f"variant {name}: master rc={rc} (see scripts/logs/tailgen_{name}_master.log)")
     with open(out) as f:
@@ -104,7 +111,7 @@ def main(argv=None) -> int:
                     help="speculative-fill settings to compare (''/'off', 'bucket', or an int)")
     ap.add_argument("--timeout", type=float, default=3600.0)
     ap.add_argument("--tiny", action="store_true", help="CPU rehearsal")
-    ap.add_argument("--out", default="scripts/tailgen_study.json")
+    ap.add_argument("--out", default=os.path.join(REPO, "scripts", "tailgen_study.json"))
     args = ap.parse_args(argv)
 
     os.makedirs(os.path.join(REPO, "scripts", "logs"), exist_ok=True)
