@@ -26,7 +26,7 @@ import numpy as np
 
 from .individuals import Individual
 from .populations import Population
-from .utils.fitness_store import is_serializable_key, tuplify
+from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
 
 __all__ = ["GeneticAlgorithm", "RussianRouletteGA"]
 
@@ -188,6 +188,7 @@ class GeneticAlgorithm:
         ]
         return {
             "algorithm": type(self).__name__,
+            "fitness_protocol": FITNESS_PROTOCOL,
             "fitness_cache": fitness_cache,
             "generation": self.generation,
             "tournament_size": self.tournament_size,
@@ -223,16 +224,32 @@ class GeneticAlgorithm:
         self.population.crossover_rate = float(pop_state["crossover_rate"])
         self.population.mutation_rate = float(pop_state["mutation_rate"])
         self.population.additional_parameters = dict(pop_state["additional_parameters"])
+        # A checkpoint written under an older fitness-measurement RNG
+        # protocol carries values a resumed search cannot compare against
+        # fresh ones (utils/fitness_store.FITNESS_PROTOCOL): drop every
+        # stored fitness — genes, RNG state, and history survive, the
+        # current population re-measures.  Loud: re-measuring costs real
+        # chip time and the user should know why.
+        proto = state.get("fitness_protocol", 1)
+        proto_ok = proto == FITNESS_PROTOCOL
+        if not proto_ok:
+            logger.warning(
+                "checkpoint was written under fitness RNG protocol %s "
+                "(current: %s); discarding its fitness values and cache — "
+                "the resumed search re-measures the current generation "
+                "instead of mixing incomparable measurements", proto,
+                FITNESS_PROTOCOL,
+            )
         individuals = []
         for ind_state in pop_state["individuals"]:
             ind = self.population.spawn(genes=ind_state["genes"])
-            if ind_state["fitness"] is not None:
+            if ind_state["fitness"] is not None and proto_ok:
                 ind.set_fitness(ind_state["fitness"])
             individuals.append(ind)
         self.population.individuals = individuals
         self.population.fitness_cache = {
             tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
-        }
+        } if proto_ok else {}
 
 
 class RussianRouletteGA(GeneticAlgorithm):

@@ -51,6 +51,7 @@ from __future__ import annotations
 import functools
 import logging
 import weakref
+import zlib
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -467,15 +468,60 @@ def _init_fn(model: MaskedGeneticCnn, input_shape: Tuple[int, ...]):
     return jax.jit(jax.vmap(over_pop, in_axes=(0, None)))
 
 
-def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed):
+def _genome_hashes(genomes: Sequence[Mapping[str, Any]]) -> np.ndarray:
+    """Stable per-genome content hash (int32) for PRNG key derivation.
+
+    Folding each population slot's keys from the genome CONTENT instead of
+    the slot index makes fitness a pure function of (architecture, config,
+    seed): invariant to batch composition, slot order, compile-bucket
+    padding, and OOM chunking (``_chunked_by_cap``).  Without this, an
+    architecture trained speculatively (``Population.speculative_fill``) or
+    in a split chunk draws different init/dropout streams than the same
+    architecture trained in its own generation's batch, so the cached
+    fitness silently steers later selections — measured as a diverged
+    search in the round-5 tailgen study.  (Cross-shape XLA recompilation
+    can still reorder float reductions, but per-slot math is slot-local;
+    in practice fitnesses now match bit-for-bit across batch shapes —
+    asserted by ``tests/test_cnn_model.py::TestBatchCompositionPurity``.)
+    """
+    out = np.empty(len(genomes), dtype=np.int64)
+    for i, g in enumerate(genomes):
+        crc = 0
+        for k in sorted(g):
+            arr = np.asarray(g[k])
+            arr = arr.astype(np.int64) if arr.dtype.kind in "biu" else arr.astype(np.float64)
+            crc = zlib.crc32(str(k).encode(), crc)
+            crc = zlib.crc32(str(arr.shape).encode(), crc)
+            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+        out[i] = crc & 0x7FFFFFFF
+    return out.astype(np.int32)
+
+
+def _content_keys(base_key, kfold: int, genome_hashes) -> jnp.ndarray:
+    """(kfold, P, 2) PRNG keys: fold index then genome content folded in."""
+    h = jnp.asarray(genome_hashes)
+    return jnp.stack(
+        [
+            jax.vmap(lambda hh, f=f: jax.random.fold_in(jax.random.fold_in(base_key, f), hh))(h)
+            for f in range(kfold)
+        ]
+    )
+
+
+def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed, genome_hashes, domain=0):
     """Per-(fold, individual) parameter init → shapes carry a (kfold, P) prefix.
 
-    Each fold trains from an independent init (seed folded per fold), matching
-    the reference's fresh model per CV fold.
+    Each fold trains from an independent init (seed folded per fold),
+    matching the reference's fresh model per CV fold; each slot's key is
+    folded from the genome content (``_genome_hashes``), so an
+    architecture's init is independent of where in which batch it trains.
+    ``domain`` separates callers (train_and_score vs CV) that would
+    otherwise replicate each other's fold-0 streams under one seed.
     """
-    keys = jnp.stack(
-        [jax.random.split(jax.random.PRNGKey(seed + f), pop_size) for f in range(kfold)]
-    )
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), 0x1217)  # domain-separated from train keys
+    if domain:
+        base = jax.random.fold_in(base, domain)
+    keys = _content_keys(base, kfold, genome_hashes)
     return _init_fn(model, tuple(input_shape))(keys, masks_stacked)
 
 
@@ -577,6 +623,11 @@ def _device_dataset(key_x, key_y, xp: np.ndarray, yp: np.ndarray, perm: np.ndarr
 #: throttles a small config evaluated later in the same process.
 _POP_PROGRAM_CAP: Dict[Any, int] = {}
 
+#: cap_keys whose cap=1 exact-size routing has already been warned about
+#: (once per config per process — the consequence is ongoing, the log
+#: line shouldn't be).
+_EXACT_ROUTE_WARNED: set = set()
+
 
 def _oom_cap_key(cfg: Dict[str, Any]):
     """Every config field that changes a program's per-genome memory —
@@ -600,7 +651,7 @@ def _is_oom_error(e: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
 
 
-def _chunked_by_cap(run, genomes, cap_key):
+def _chunked_by_cap(run, genomes, cap_key, run_exact=None):
     """Run the batched evaluator, splitting the population on device OOM.
 
     BASELINE config #5 (S=(5,5,5), 256 channels, pop=50) is sized for a
@@ -611,28 +662,61 @@ def _chunked_by_cap(run, genomes, cap_key):
     pre-chunk instead of re-discovering the OOM.  On a big mesh the pop
     axis shards and no OOM ever happens, so the cap stays unset and
     behavior is unchanged.
+
+    ``run_exact`` is the unpadded (exact-size) runner: since the compile
+    bucket floors at 2, a singleton chunk padded by ``run`` still executes
+    a 2-wide program, so a learned cap of 1 is only honorable — and a
+    last-genome OOM only survivable — by dropping the padding.  Once
+    cap=1 is learned, EVERY evaluation for that config routes through the
+    1-wide unpadded program, so batch-composition purity is gone for the
+    rest of the search (values measured before the boundary came from
+    multi-slot programs) — survival over purity, warned once per config.
     """
     cap = _POP_PROGRAM_CAP.get(cap_key)
     if cap is not None and len(genomes) > cap:
         return np.concatenate(
-            [_chunked_by_cap(run, genomes[i : i + cap], cap_key)
+            [_chunked_by_cap(run, genomes[i : i + cap], cap_key, run_exact)
              for i in range(0, len(genomes), cap)]
         )
+    if cap == 1 and len(genomes) == 1 and run_exact is not None:
+        if cap_key not in _EXACT_ROUTE_WARNED:
+            _EXACT_ROUTE_WARNED.add(cap_key)
+            logger.warning(
+                "config with learned memory cap=1: all its evaluations now "
+                "run 1-wide unpadded — fitnesses measured before this "
+                "boundary came from numerically distinct multi-slot "
+                "programs (batch-composition purity does not hold across "
+                "the cap=1 boundary)",
+            )
+        return run_exact(genomes)
+    fallback = None
     try:
         return run(genomes)
     except Exception as e:
-        if not _is_oom_error(e) or len(genomes) <= 1:
+        if not _is_oom_error(e):
             raise
-        half = max(1, len(genomes) // 2)
-        b = 1
-        while b * 2 <= half:
-            b *= 2
-        _POP_PROGRAM_CAP[cap_key] = b
-        logger.warning(
-            "population batch of %d genomes exhausted device memory; "
-            "chunking to <=%d genomes per program (remembered for this "
-            "config in this process)", len(genomes), b,
-        )
+        if len(genomes) <= 1:
+            if run_exact is None:
+                raise
+            _POP_PROGRAM_CAP[cap_key] = 1
+            logger.warning(
+                "singleton population batch exhausted device memory in its "
+                "padded (2-wide) program; retrying exact-size (1-wide, "
+                "unpadded — batch-composition purity does not hold for "
+                "this genome)",
+            )
+            fallback = run_exact
+        else:
+            half = max(1, len(genomes) // 2)
+            b = 1
+            while b * 2 <= half:
+                b *= 2
+            _POP_PROGRAM_CAP[cap_key] = b
+            logger.warning(
+                "population batch of %d genomes exhausted device memory; "
+                "chunking to <=%d genomes per program (remembered for this "
+                "config in this process)", len(genomes), b,
+            )
     # Retry OUTSIDE the except block, deliberately: the failed attempt's
     # exception traceback pins the frames (and therefore the device
     # buffers) of the too-large execution — recursing inside the handler
@@ -643,7 +727,9 @@ def _chunked_by_cap(run, genomes, cap_key):
     import gc
 
     gc.collect()
-    return _chunked_by_cap(run, genomes, cap_key)
+    if fallback is not None:
+        return fallback(genomes)
+    return _chunked_by_cap(run, genomes, cap_key, run_exact)
 
 
 def _pop_bucket(n: int) -> int:
@@ -653,14 +739,21 @@ def _pop_bucket(n: int) -> int:
     evaluate whatever the fitness cache didn't answer — small, varying
     batches (5, 2, 1, ...) — and each distinct size would otherwise pay a
     full XLA compile (minutes for CIFAR-scale configs).  Bucketing bounds a
-    search to at most {1, 2, 4, 8, 16} small shapes plus the full-population
+    search to at most {2, 4, 8, 16} small shapes plus the full-population
     shape; waste is < 2× and only where the absolute cost is small.  Batches
     ≥ 16 stay exact — they are the dominant cost and occur at one stable
     size (the full population).
+
+    The floor is 2, not 1: XLA compiles a singleton population axis to a
+    different program (the vmap axis collapses) whose float rounding can
+    flip a prediction vs the same genome trained in a wider batch —
+    breaking the batch-composition purity that ``_genome_hashes`` buys
+    (measured: one-sample accuracy flip at pop=1 on CPU).  Bucket 2 keeps
+    every padded batch on the same multi-slot program family.
     """
     if n >= 16:
         return n
-    b = 1
+    b = 2
     while b < n:
         b *= 2
     return b
@@ -723,7 +816,7 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
         compute_dtype=jnp.dtype(cfg["compute_dtype"]),
         stage_exit_conv=bool(cfg["stage_exit_conv"]),
     )
-    return mesh, genomes, n_real, len(genomes), stacked, model
+    return mesh, genomes, n_real, len(genomes), stacked, model, _genome_hashes(genomes)
 
 
 class GeneticCnnModel(GentunModel):
@@ -860,14 +953,15 @@ class GeneticCnnModel(GentunModel):
                 for r in range(reps)
             ]
             return np.mean(per_rep, axis=0, dtype=np.float64).astype(np.float32)
-        if len(genomes) > 1:
-            cfg0 = _normalize_config(x_train, y_train, config)
-            return _chunked_by_cap(
-                lambda gs: cls._cross_validate_population_one(x_train, y_train, gs, **config),
-                list(genomes),
-                _oom_cap_key(cfg0),
-            )
-        return cls._cross_validate_population_one(x_train, y_train, genomes, **config)
+        cfg0 = _normalize_config(x_train, y_train, config)
+        return _chunked_by_cap(
+            lambda gs: cls._cross_validate_population_one(x_train, y_train, gs, **config),
+            list(genomes),
+            _oom_cap_key(cfg0),
+            run_exact=lambda gs: cls._cross_validate_population_one(
+                x_train, y_train, gs, **{**config, "pop_padding": False}
+            ),
+        )
 
     @classmethod
     def _cross_validate_population_one(
@@ -881,7 +975,7 @@ class GeneticCnnModel(GentunModel):
         x, y = _prepare_data(x_train, y_train, cfg)
         if len(genomes) == 0:
             return np.zeros((0,), dtype=np.float32)
-        mesh, genomes, n_real, pop, stacked, model = _prepare_population_setup(cfg, genomes)
+        mesh, genomes, n_real, pop, stacked, model, hashes = _prepare_population_setup(cfg, genomes)
 
         kfold = cfg["kfold"]
         n = x.shape[0]
@@ -923,12 +1017,9 @@ class GeneticCnnModel(GentunModel):
             )
 
         params = _init_population_params(
-            model, stacked, cfg["input_shape"], pop, kfold, cfg["seed"]
+            model, stacked, cfg["input_shape"], pop, kfold, cfg["seed"], hashes
         )
-        base_key = jax.random.PRNGKey(cfg["seed"])
-        fold_keys = jnp.stack(
-            [jax.random.split(jax.random.fold_in(base_key, f), pop) for f in range(kfold)]
-        )
+        fold_keys = _content_keys(jax.random.PRNGKey(cfg["seed"]), kfold, hashes)
 
         if not cfg["fold_parallel"]:
             accs = _run_segmented(
@@ -995,14 +1086,15 @@ class GeneticCnnModel(GentunModel):
                 for r in range(reps)
             ]
             return np.mean(per_rep, axis=0, dtype=np.float64).astype(np.float32)
-        if len(genomes) > 1:
-            cfg0 = _normalize_config(x_train, y_train, config)
-            return _chunked_by_cap(
-                lambda gs: cls._train_and_score_one(x_train, y_train, x_test, y_test, gs, **config),
-                list(genomes),
-                _oom_cap_key(cfg0),
-            )
-        return cls._train_and_score_one(x_train, y_train, x_test, y_test, genomes, **config)
+        cfg0 = _normalize_config(x_train, y_train, config)
+        return _chunked_by_cap(
+            lambda gs: cls._train_and_score_one(x_train, y_train, x_test, y_test, gs, **config),
+            list(genomes),
+            _oom_cap_key(cfg0),
+            run_exact=lambda gs: cls._train_and_score_one(
+                x_train, y_train, x_test, y_test, gs, **{**config, "pop_padding": False}
+            ),
+        )
 
     @classmethod
     def _train_and_score_one(
@@ -1030,7 +1122,7 @@ class GeneticCnnModel(GentunModel):
         x_te, y_te = _prepare_data(x_test, y_test, cfg)
         if len(genomes) == 0:
             return np.zeros((0,), dtype=np.float32)
-        mesh, genomes, n_real, pop, stacked, model = _prepare_population_setup(cfg, genomes)
+        mesh, genomes, n_real, pop, stacked, model, hashes = _prepare_population_setup(cfg, genomes)
 
         n_tr, n_te = x_tr.shape[0], x_te.shape[0]
         batch_size = min(cfg["batch_size"], n_tr)
@@ -1048,10 +1140,17 @@ class GeneticCnnModel(GentunModel):
         val_idx = (n_tr + np.concatenate([np.arange(n_te), np.zeros(pad)])).astype(np.int32)[None]
         val_weight = np.concatenate([np.ones(n_te, np.float32), np.zeros(pad, np.float32)])[None]
 
+        # Domain-separate the holdout training from CV fold 0: without it,
+        # train_and_score under the search's own seed would replicate the
+        # CV fold-0 init/dropout streams bit-for-bit, correlating the
+        # holdout estimate with the CV estimate it is supposed to check.
         params = _init_population_params(
-            model, stacked, cfg["input_shape"], pop, 1, cfg["seed"]
+            model, stacked, cfg["input_shape"], pop, 1, cfg["seed"], hashes,
+            domain=0x5C04E,
         )
-        keys = jnp.stack([jax.random.split(jax.random.PRNGKey(cfg["seed"]), pop)])
+        keys = _content_keys(
+            jax.random.fold_in(jax.random.PRNGKey(cfg["seed"]), 0x5C04E), 1, hashes
+        )
         x_full = np.concatenate([x_tr, x_te], axis=0)
         y_full = np.concatenate([y_tr, y_te], axis=0)
         # The holdout is one "fold"; the segmented executor drives it with

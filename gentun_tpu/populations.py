@@ -42,8 +42,8 @@ def _compile_bucket(n: int) -> int:
     asserts the two stay in lockstep."""
     if n >= 16:
         return n
-    b = 1
-    while b < n:
+    b = 2  # floor 2, matching _pop_bucket: singleton programs are
+    while b < n:  # numerically distinct (see models/cnn._pop_bucket)
         b *= 2
     return b
 

@@ -41,7 +41,21 @@ import os
 import tempfile
 from typing import Any, Dict
 
-__all__ = ["load_fitness_cache", "save_fitness_cache", "tuplify", "is_serializable_key"]
+__all__ = [
+    "load_fitness_cache", "save_fitness_cache", "tuplify",
+    "is_serializable_key", "FITNESS_PROTOCOL",
+]
+
+#: Fitness-measurement RNG protocol.  Bump whenever a model's fitness for
+#: the SAME (cache_key, config, seed) changes incompatibly, so persisted
+#: values from older protocols are never silently mixed with new
+#: measurements (mixed protocols steer a search exactly the way the
+#: content-hash purity work exists to prevent).  History:
+#:   1 — per-slot PRNG keys (``split(PRNGKey(seed+f), pop)``), rounds 1-4:
+#:       fitness depended on batch slot/composition;
+#:   2 — content-hash keys (``models/cnn._genome_hashes``), round 5:
+#:       fitness is a pure function of (architecture, config, seed).
+FITNESS_PROTOCOL = 2
 
 
 def tuplify(obj: Any) -> Any:
@@ -107,8 +121,21 @@ def load_fitness_cache(path: str) -> Dict[Any, float]:
     try:
         with open(path) as f:
             payload = json.load(f)
+        proto = payload.get("protocol", 1)
+        if proto != FITNESS_PROTOCOL:
+            import logging
+
+            logging.getLogger("gentun_tpu").warning(
+                "fitness store %s was measured under RNG protocol %s "
+                "(current: %s); IGNORING its entries — fitness values are "
+                "not comparable across protocols, and mixing them would "
+                "silently steer the search.  The file is left untouched; "
+                "the next save rewrites it at the current protocol.",
+                path, proto, FITNESS_PROTOCOL,
+            )
+            return {}
         return {tuplify(k): float(v) for k, v in payload["entries"]}
-    except (ValueError, KeyError, TypeError) as e:
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
         backup = path + ".corrupt"
         try:
             os.replace(path, backup)
@@ -140,7 +167,11 @@ def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
             if not is_serializable_key(k):
                 continue
             merged[k] = float(v)
-        payload = {"version": 1, "entries": [[k, v] for k, v in merged.items()]}
+        payload = {
+            "version": 1,
+            "protocol": FITNESS_PROTOCOL,
+            "entries": [[k, v] for k, v in merged.items()],
+        }
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".fitness-", suffix=".json")
         try:
             with os.fdopen(fd, "w") as f:

@@ -47,7 +47,7 @@ def decompose(cfg_overrides=None, pop=bench.POP, trace_dir=None):
     t0 = time.time()
     cfg = M._normalize_config(x, y, dict(config))
     xp, yp = M._prepare_data(x, y, cfg)
-    mesh, genomes_p, n_real, pop_p, stacked, model = M._prepare_population_setup(cfg, genomes)
+    mesh, genomes_p, n_real, pop_p, stacked, model, hashes = M._prepare_population_setup(cfg, genomes)
     kfold = cfg["kfold"]
     n = xp.shape[0]
     fold_size = n // kfold
@@ -87,15 +87,12 @@ def decompose(cfg_overrides=None, pop=bench.POP, trace_dir=None):
     # -- phase 3: parameter init (jitted, fold x pop vmapped)
     t0 = time.time()
     params = M._init_population_params(
-        model, stacked, cfg["input_shape"], pop_p, kfold, cfg["seed"]
+        model, stacked, cfg["input_shape"], pop_p, kfold, cfg["seed"], hashes
     )
     jax.block_until_ready(params)
     phases["param_init"] = time.time() - t0
 
-    base_key = jax.random.PRNGKey(cfg["seed"])
-    fold_keys = jnp.stack(
-        [jax.random.split(jax.random.fold_in(base_key, f), pop_p) for f in range(kfold)]
-    )
+    fold_keys = M._content_keys(jax.random.PRNGKey(cfg["seed"]), kfold, hashes)
 
     # -- phase 4/5: the segmented executor, fenced per phase
     init_pop, train_pop, eval_pop = M._fold_segment_fns(

@@ -148,8 +148,18 @@ def main(argv=None) -> int:
     if len(names) >= 2:
         fits = {record["variants"][n]["best_fitness"] for n in names}
         if len(fits) > 1:
-            print("WARNING: best fitness differs between variants — the "
-                  "searches diverged (should be identical-seed identical)", flush=True)
+            spread = max(fits) - min(fits)
+            # Content-hash PRNG keys (models/cnn._genome_hashes) remove all
+            # systematic divergence; what can remain on TPU is a rare
+            # validation-sample flip when speculation moves an architecture
+            # to a different program SHAPE (XLA rounds differently).  A
+            # spread at or below a few validation samples is that; anything
+            # larger means a protocol bug.
+            kind = ("cross-program-shape rounding (expected, sample-level)"
+                    if spread < 1e-3 else "PROTOCOL-LEVEL — investigate")
+            print(f"NOTE: best fitness differs between variants by {spread:.6f}: "
+                  f"{kind}", flush=True)
+            record["best_fitness_spread"] = round(spread, 6)
         # Compare each later variant against the LAST plain-off run (the
         # warmest apples-to-apples baseline when 'off' appears twice).
         offs = [n for n in names if n.startswith("off")]

@@ -186,3 +186,29 @@ def test_state_dict_round_trip():
     b1 = ga.run(3)
     b2 = ga2.run(3)
     assert b1.get_genes() == b2.get_genes()
+
+
+def test_old_fitness_protocol_checkpoint_drops_measurements(caplog):
+    """A checkpoint written under the old slot-indexed RNG protocol must
+    not feed its fitnesses into a resumed search (they are not comparable
+    with content-hash measurements — utils/fitness_store.FITNESS_PROTOCOL);
+    genes, RNG state, and history survive, everything re-measures."""
+    import logging
+
+    pop = make_population(size=6, seed=1)
+    ga = GeneticAlgorithm(pop, seed=1)
+    ga.evolve_population()
+    state = ga.state_dict()
+    assert state["fitness_protocol"] == 2
+    assert any(i["fitness"] is not None for i in state["population"]["individuals"])
+    state["fitness_protocol"] = 1  # simulate a round-4-era checkpoint
+
+    pop2 = make_population(size=6, seed=99)
+    ga2 = GeneticAlgorithm(pop2, seed=99)
+    with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+        ga2.load_state_dict(state)
+    assert "protocol" in caplog.text
+    assert ga2.population.fitness_cache == {}
+    assert all(not i.fitness_evaluated for i in ga2.population)
+    # the trajectory itself still resumes (genes + RNG state intact)
+    assert [i.get_genes() for i in ga2.population] == [i.get_genes() for i in ga.population]

@@ -261,7 +261,7 @@ class TestEntryChannelPad:
         flat = x.reshape(x.shape[0], -1)
         m = GeneticCnnModel(
             flat, y, {"S_1": (1, 0, 1)}, input_shape=(8, 8, 1),
-            entry_channel_pad=4, **FAST
+            entry_channel_pad=4, **{**FAST, "epochs": (4,)}
         )
         assert 0.4 < m.cross_validate() <= 1.0
 
@@ -357,7 +357,8 @@ class TestPopBucketing:
     def test_bucket_function(self):
         from gentun_tpu.models.cnn import _pop_bucket
 
-        assert [_pop_bucket(n) for n in (1, 2, 3, 5, 8, 9, 15)] == [1, 2, 4, 8, 8, 16, 16]
+        # floor is 2: the singleton program is numerically distinct (purity)
+        assert [_pop_bucket(n) for n in (1, 2, 3, 5, 8, 9, 15)] == [2, 2, 4, 8, 8, 16, 16]
         assert _pop_bucket(16) == 16 and _pop_bucket(20) == 20  # large = exact
 
     def test_small_batches_share_compiled_shape(self, separable_data):
@@ -493,13 +494,39 @@ class TestOomChunking:
         with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
             cnn_mod._chunked_by_cap(run, [{"S_1": (1,)}], ("test-cfg-c",))
 
-    def test_chunked_matches_manual_chunks_real_model(self, separable_data):
-        """A capped run must equal evaluating the same chunks directly.
+    def test_single_genome_oom_falls_back_to_exact_runner(self):
+        """The compile bucket floors at 2, so a singleton OOM must retry
+        via the UNPADDED runner (a genuinely 1-wide program) — and the
+        learned cap=1 must route straight there on later generations."""
+        from gentun_tpu.models import cnn as cnn_mod
 
-        (Chunked vs UNchunked equality is deliberately not asserted:
-        per-slot parameter init makes a genome's measured fitness depend
-        on its batch, like any bucket-size change — the fitness cache is
-        what gives a genome one stable measurement per search.)"""
+        calls = []
+
+        def run(genomes):
+            calls.append(("padded", len(genomes)))
+            raise RuntimeError("RESOURCE_EXHAUSTED")
+
+        def run_exact(genomes):
+            calls.append(("exact", len(genomes)))
+            return np.full(len(genomes), 0.5, dtype=np.float32)
+
+        key = ("test-cfg-exact",)
+        try:
+            got = cnn_mod._chunked_by_cap(run, [{"S_1": (1,)}], key, run_exact)
+            assert got.tolist() == [0.5]
+            assert calls == [("padded", 1), ("exact", 1)]
+            assert cnn_mod._POP_PROGRAM_CAP[key] == 1
+            # cap remembered: the padded runner is never tried again
+            cnn_mod._chunked_by_cap(run, [{"S_1": (1,)}, {"S_1": (0,)}], key, run_exact)
+            assert calls[2:] == [("exact", 1), ("exact", 1)]
+        finally:
+            cnn_mod._POP_PROGRAM_CAP.pop(key, None)
+
+    def test_chunked_matches_manual_chunks_real_model(self, separable_data):
+        """A capped run equals evaluating the same chunks directly — AND
+        equals the unchunked run: PRNG keys are content-derived
+        (``_genome_hashes``), so chunking cannot move any fitness
+        (``TestBatchCompositionPurity``)."""
         from gentun_tpu.models import cnn as cnn_mod
         from gentun_tpu.models.cnn import GeneticCnnModel
 
@@ -508,10 +535,14 @@ class TestOomChunking:
         cfg = dict(nodes=(3,), kernels_per_layer=(8,), dense_units=32,
                    kfold=2, epochs=(1,), learning_rate=(0.05,),
                    batch_size=32, compute_dtype="float32", seed=0)
+        unchunked = np.asarray(
+            GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
+        )
         want = np.concatenate([
             np.asarray(GeneticCnnModel.cross_validate_population(x, y, genomes[:2], **cfg)),
             np.asarray(GeneticCnnModel.cross_validate_population(x, y, genomes[2:], **cfg)),
         ])
+        np.testing.assert_array_equal(want, unchunked)
         key = cnn_mod._oom_cap_key(cnn_mod._normalize_config(x, y, dict(cfg)))
         cnn_mod._POP_PROGRAM_CAP[key] = 2  # force chunking: 2 + 1
         try:
@@ -519,3 +550,46 @@ class TestOomChunking:
         finally:
             cnn_mod._POP_PROGRAM_CAP.pop(key, None)
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+class TestBatchCompositionPurity:
+    """Fitness is a pure function of (architecture, config, seed).
+
+    ``_genome_hashes`` folds each slot's PRNG keys from genome content, so
+    WHERE an architecture trains — slot index, batch composition,
+    compile-bucket shape, alone or among others — cannot change its
+    fitness.  This is the property the speculative-fill trajectory-identity
+    claim and the cross-run fitness store both rest on (round-5 tailgen
+    study measured a diverged search before this fix).
+
+    The cross-bucket assertions below are EXACT on purpose: the suite is
+    pinned to CPU (conftest), where XLA's different-program-shape
+    compilations round identically, so any inequality here is an RNG
+    regression, never float noise.  On TPU the same comparison may flip a
+    rare validation sample across program shapes (PERF.md "Tail
+    generations") — these tests are not meant to run there."""
+
+    def test_fitness_invariant_to_slot_batch_and_bucket(self, separable_data):
+        x, y = separable_data
+        g = lambda bits: {"S_1": bits}
+        a, b, c = g((1, 0, 1)), g((0, 1, 0)), g((1, 1, 1))
+        batch = GeneticCnnModel.cross_validate_population(x, y, [a, b, c], **FAST)  # bucket 4
+        alone = GeneticCnnModel.cross_validate_population(x, y, [b], **FAST)        # bucket 2
+        swapped = GeneticCnnModel.cross_validate_population(
+            x, y, [c, b, a, b, a], **FAST                                          # bucket 8
+        )
+        # exact equality: the per-slot streams are content-derived and the
+        # per-slot math is slot-local, so not even float rounding may move
+        assert alone[0] == batch[1]
+        assert (swapped[0], swapped[1], swapped[2]) == (batch[2], batch[1], batch[0])
+        assert swapped[3] == batch[1] and swapped[4] == batch[0]  # in-batch twins too
+
+    def test_hashes_are_content_not_position(self):
+        from gentun_tpu.models.cnn import _genome_hashes
+
+        g1 = {"S_1": (1, 0, 1), "S_2": (0, 1, 1, 0, 0, 1)}
+        g2 = {"S_1": (0, 1, 1), "S_2": (0, 1, 1, 0, 0, 1)}
+        h = _genome_hashes([g1, g2, g1])
+        assert h[0] == h[2] != h[1]
+        # order of evaluation / position in the list is irrelevant
+        assert _genome_hashes([g2, g1])[1] == h[0]

@@ -107,6 +107,30 @@ class TestFitnessStore:
         assert save_fitness_cache({("a",): 1.0}, path) == 1
         assert load_fitness_cache(path) == {("a",): 1.0}
 
+    def test_old_protocol_store_ignored_loudly(self, tmp_path, caplog):
+        """Values measured under the old slot-indexed RNG protocol are not
+        comparable with content-hash measurements; loading must refuse them
+        rather than silently steer the search (round-5 purity work)."""
+        import json
+        import logging
+
+        from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
+        from gentun_tpu.utils.fitness_store import FITNESS_PROTOCOL
+
+        path = str(tmp_path / "fit.json")
+        (tmp_path / "fit.json").write_text(
+            json.dumps({"version": 1, "entries": [[["a"], 0.9]]})  # protocol-1 file
+        )
+        with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+            assert load_fitness_cache(path) == {}
+        assert "protocol" in caplog.text
+        assert not (tmp_path / "fit.json.corrupt").exists()  # not corruption
+        # saving rewrites at the current protocol; the old entries stay dropped
+        save_fitness_cache({("b",): 1.0}, path)
+        payload = json.loads((tmp_path / "fit.json").read_text())
+        assert payload["protocol"] == FITNESS_PROTOCOL
+        assert load_fitness_cache(path) == {("b",): 1.0}
+
     def test_unserializable_keys_skipped(self, tmp_path):
         from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
 
