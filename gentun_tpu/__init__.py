@@ -52,7 +52,7 @@ __all__ = [
     "RussianRouletteGA",
 ]
 
-__version__ = "0.5.0"  # keep in sync with pyproject.toml
+__version__ = "0.6.0"  # keep in sync with pyproject.toml
 
 # Fitness models pull in jax/flax/sklearn; keep them optional at import time,
 # matching the reference's try/except around model imports (SURVEY.md §2.0

@@ -236,6 +236,14 @@ def main(argv=None) -> int:
     ap.add_argument("--analyze-only", action="store_true",
                     help="recompute SEARCH.md (incl. paired statistics) from "
                          "the existing JSON sidecar without retraining")
+    ap.add_argument("--arms", nargs="+", default=["tournament", "roulette", "random"],
+                    choices=["tournament", "roulette", "random"],
+                    help="searcher arms to run (use with --merge to extend "
+                         "only the statistically unresolved comparisons)")
+    ap.add_argument("--merge", action="store_true",
+                    help="append new arm×seed runs to the existing sidecar "
+                         "(already-present arm×seed combos are skipped) "
+                         "instead of starting a fresh measurement")
     args = ap.parse_args(argv)
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -265,9 +273,48 @@ def main(argv=None) -> int:
     x_te, y_te = x_all[args.n_train :], y_all[args.n_train :]
 
     t0 = time.time()
-    results: dict = {"config": vars(args) | {"dataset": meta["source"], "nodes": list(NODES)}}
+    sidecar = os.path.join(repo, "scripts", "search_efficacy.json")
+    if set(args.arms) != {"tournament", "roulette", "random"} and not args.merge:
+        # A subset run without --merge would clobber the committed sidecar
+        # with partial data and then crash write_markdown on the absent arms.
+        raise SystemExit("--arms with a subset of searchers requires --merge")
+    if args.merge and os.path.exists(sidecar):
+        with open(sidecar) as f:
+            results = json.load(f)
+        # Refuse to mix measurements from different experimental setups —
+        # the paired statistics assume one workload.  A key the old sidecar
+        # never recorded is itself a setup mismatch: we cannot prove the
+        # old runs used this invocation's value.
+        pcfg = results["config"]
+        for k in ("budget", "pop", "n_train", "n_test", "fitness_reps"):
+            if pcfg.get(k, "<absent>") != getattr(args, k):
+                raise SystemExit(
+                    f"--merge: config mismatch on {k}: sidecar has "
+                    f"{pcfg.get(k, '<absent>')}, this invocation has {getattr(args, k)}"
+                )
+    else:
+        results = {"config": vars(args) | {"dataset": meta["source"], "nodes": list(NODES)}}
+    done = {(n, r["seed"]) for n in ("tournament", "roulette", "random")
+            for r in results.get(n, [])}
+    from gentun_tpu.utils.fitness_store import FITNESS_PROTOCOL
+
+    prev_wall = float(results.get("total_wall_s", 0.0))
+
+    def reconcile():
+        """Keep every on-disk snapshot self-consistent: seed union and
+        running wall time, so a killed run (or --analyze-only on its
+        snapshot) never sees records the header doesn't account for."""
+        results["config"]["seeds"] = sorted(
+            {r["seed"] for n in ("tournament", "roulette", "random")
+             for r in results.get(n, [])}
+        )
+        results["total_wall_s"] = round(prev_wall + (time.time() - t0), 1)
+
     for seed in args.seeds:
-        for name in ("tournament", "roulette", "random"):
+        for name in args.arms:
+            if (name, seed) in done:
+                print(f"[{name} seed={seed}] already in sidecar — skipped", flush=True)
+                continue
             t1 = time.time()
             if name == "random":
                 curve, top_genomes, best_fit, n_distinct = run_random(seed, args.budget, args.pop, x, y)
@@ -289,12 +336,18 @@ def main(argv=None) -> int:
                     "n_distinct": n_distinct,
                     "top_genomes": [{k: list(v) for k, v in g.items()} for g in top_genomes],
                     "wall_s": round(time.time() - t1, 1),
+                    "rng_protocol": FITNESS_PROTOCOL,
                 }
             )
             print(f"[{name} seed={seed}] best_cv={best_fit:.4f} holdout={held:.4f} "
                   f"({time.time() - t1:.0f}s)", flush=True)
+            reconcile()
+            with open(sidecar, "w") as f:  # incremental: arm×seed = TPU minutes
+                json.dump(results, f, indent=1)
 
-    results["total_wall_s"] = round(time.time() - t0, 1)
+    # Per-arm seed sets may now differ (targeted --merge extensions); the
+    # header and the paired stats read what is actually there.
+    reconcile()
     results["backend"] = _backend_desc()  # recorded now: --analyze-only must
     # not call jax.devices() later (it could poke the TPU under another
     # process's feet — the one-TPU-process rule)
@@ -339,6 +392,18 @@ def write_markdown(results: dict, out_md: str, args) -> None:
         "",
         "## Best CV fitness vs budget (mean ± spread over seeds "
         f"{results['config']['seeds']})",
+    ]
+    counts = {n: len(results.get(n, [])) for n in ("tournament", "roulette", "random")}
+    if len(set(counts.values())) > 1:
+        lines += [
+            "",
+            "Arms carry different seed counts (targeted `--merge` extensions "
+            "of the unresolved comparisons): "
+            + ", ".join(f"{n} n={c}" for n, c in counts.items())
+            + ".  Paired rows below state their own n; marginal cells pool "
+            "whatever seeds the arm has.",
+        ]
+    lines += [
         "",
         "| trained architectures | " + " | ".join(
             ["tournament GA", "roulette GA (paper)", "random control"]) + " |",
@@ -503,9 +568,23 @@ def write_markdown(results: dict, out_md: str, args) -> None:
         "**Takeaway:** " + "  ".join(concl),
         "",
         f"Per-seed curves: JSON sidecar.  Total wall time: "
-        f"{results['total_wall_s']}s on {results.get('backend') or 'unrecorded backend'}.",
+        f"{results.get('total_wall_s', '<mid-run snapshot>')}s on "
+        f"{results.get('backend') or 'unrecorded backend'}.",
         "",
     ]
+    protos = sorted({r.get("rng_protocol", 1)
+                     for n in ("tournament", "roulette", "random")
+                     for r in results.get(n, [])})
+    if protos != [2]:
+        lines += [
+            "Protocol provenance: records span fitness RNG protocol(s) "
+            f"{protos} (1 = per-slot keys, rounds 1-4; 2 = content-hash keys, "
+            "round 5 — `models/cnn.py::_genome_hashes`).  Both draw "
+            "init/dropout streams from identical distributions, and each "
+            "seed's arms run under one protocol, so the paired statistics "
+            "are unaffected in expectation; only individual draws differ.",
+            "",
+        ]
     with open(out_md, "w") as f:
         f.write("\n".join(lines))
 

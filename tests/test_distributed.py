@@ -1077,3 +1077,55 @@ class TestFinalResultsNotLostOnExit:
             assert pop.evaluate() == 6  # every result of the final batch arrived
             t.join(timeout=10.0)
             assert not t.is_alive()
+
+
+class TestDistributedFitnessPurity:
+    """Distributed evaluation must be bit-identical to local evaluation.
+
+    The worker trains whatever job batch the broker hands it (capacity
+    chunks, arrival order) — compositions the local ``evaluate()`` never
+    produces.  Content-hash PRNG keys (``models/cnn._genome_hashes``)
+    make fitness a pure function of (architecture, config, seed), so the
+    transport layer cannot move a measurement."""
+
+    def test_capacity_chunked_worker_matches_local_bitwise(self):
+        from gentun_tpu import GeneticCnnIndividual
+
+        rng = np.random.default_rng(3)
+        protos = rng.normal(size=(3, 8, 8, 1)).astype(np.float32)
+        yv = rng.integers(0, 3, size=96).astype(np.int32)
+        xv = (protos[yv] + 0.25 * rng.normal(size=(96, 8, 8, 1))).astype(np.float32)
+        params = dict(nodes=(3,), kernels_per_layer=(6,), kfold=2, epochs=(1,),
+                      learning_rate=(0.05,), batch_size=32, dense_units=16,
+                      compute_dtype="float32", seed=0)
+
+        local = Population(GeneticCnnIndividual, x_train=xv, y_train=yv,
+                           size=6, seed=5, additional_parameters=params)
+        local.evaluate()
+        local_fits = {ind.cache_key(): ind.get_fitness() for ind in local}
+
+        # capacity=2: the worker trains 2-wide chunks — different program
+        # shapes AND different batch compositions than the local one-shot
+        with DistributedPopulation(GeneticCnnIndividual, size=6, seed=5,
+                                   additional_parameters=params, port=0) as dist:
+            _, port = dist.broker_address
+            stop = threading.Event()
+            t = threading.Thread(
+                target=lambda: GentunClient(
+                    GeneticCnnIndividual, xv, yv, host="127.0.0.1", port=port,
+                    capacity=2, heartbeat_interval=0.2, reconnect_delay=0.1,
+                ).work(stop_event=stop),
+                daemon=True,
+            )
+            t.start()
+            try:
+                dist.evaluate()
+                assert all(ind.fitness_evaluated for ind in dist)
+                for ind in dist:
+                    assert ind.get_fitness() == local_fits[ind.cache_key()], (
+                        "distributed fitness differs from local for the same "
+                        "architecture under the same config+seed"
+                    )
+            finally:
+                stop.set()
+                t.join(timeout=15.0)
