@@ -189,18 +189,37 @@ class JobBroker:
         self._stopping = True
         loop = self._loop
 
-        def _shutdown():
-            if self._reaper_task is not None:
-                self._reaper_task.cancel()
-            for w in list(self._workers.values()):
-                w.writer.close()
-            if self._server is not None:
-                self._server.close()
-            loop.stop()
+        async def _shutdown():
+            # loop.stop() sits in the finally: if any close() below raises,
+            # run_forever must still return — otherwise the loop thread
+            # outlives stop() as an unjoinable zombie holding the port.
+            try:
+                for w in list(self._workers.values()):
+                    w.writer.close()
+                if self._server is not None:
+                    self._server.close()
+                # Cancel every other task — connection handlers, the reaper
+                # — and WAIT for their cleanup before stopping the loop:
+                # stopping with handlers still parked on readline() destroys
+                # pending tasks ("Task was destroyed but it is pending!" at
+                # every master exit) and skips their finally-block cleanup.
+                tasks = [t for t in asyncio.all_tasks(loop)
+                         if t is not asyncio.current_task()]
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+            finally:
+                loop.stop()
 
-        loop.call_soon_threadsafe(_shutdown)
+        loop.call_soon_threadsafe(lambda: asyncio.ensure_future(_shutdown()))
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+            if self._thread.is_alive():  # pragma: no cover - defensive
+                logger.warning(
+                    "broker loop thread did not exit within 5s of stop(); "
+                    "abandoning it (daemon) — port may stay bound until "
+                    "process exit"
+                )
         self._thread = None
         self._loop = None
         self._started.clear()

@@ -1129,3 +1129,34 @@ class TestDistributedFitnessPurity:
             finally:
                 stop.set()
                 t.join(timeout=15.0)
+
+
+class TestCleanShutdown:
+    def test_stop_drains_connection_handlers(self):
+        """stop() must cancel and DRAIN the per-connection handler
+        coroutines before stopping the loop.  Stopping with handlers
+        parked on readline() left pending tasks (asyncio logged "Task was
+        destroyed but it is pending!" at master exit) and — the
+        deterministic symptom asserted here — skipped the handlers'
+        finally-block cleanup, leaving the dead connection registered in
+        the worker table after shutdown."""
+        import json
+        import socket
+
+        broker = JobBroker(port=0).start()
+        host, port = broker.address
+        s = socket.create_connection((host, port))
+        try:
+            s.sendall((json.dumps({"type": "hello", "worker_id": "w1",
+                                   "token": None, "capacity": 1,
+                                   "n_chips": 1, "backend": "test"}) + "\n").encode())
+            deadline = time.monotonic() + 5.0
+            # fleet_chips() floors at 1, so wait on the worker table itself
+            while not broker._workers and time.monotonic() < deadline:
+                time.sleep(0.05)  # handler task now parked on readline()
+            assert broker._workers  # hello processed, handler registered
+            broker.stop()
+            # the handler's finally ran during shutdown: worker table empty
+            assert broker._workers == {}
+        finally:
+            s.close()
