@@ -508,6 +508,16 @@ def _content_keys(base_key, kfold: int, genome_hashes) -> jnp.ndarray:
     )
 
 
+#: Domain constants for PRNG stream separation.  _INIT_DOMAIN keeps
+#: parameter-init streams disjoint from train (dropout) streams under one
+#: seed; _HOLDOUT_DOMAIN keeps train_and_score's streams disjoint from CV
+#: fold 0's (same formula, kfold=1 → fold index 0) so a holdout training
+#: under the search's own seed can never bit-replicate the CV training it
+#: is supposed to independently check.
+_INIT_DOMAIN = 0x1217
+_HOLDOUT_DOMAIN = 0x5C04E
+
+
 def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape, pop_size, kfold, seed, genome_hashes, domain=0):
     """Per-(fold, individual) parameter init → shapes carry a (kfold, P) prefix.
 
@@ -518,7 +528,7 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
     ``domain`` separates callers (train_and_score vs CV) that would
     otherwise replicate each other's fold-0 streams under one seed.
     """
-    base = jax.random.fold_in(jax.random.PRNGKey(seed), 0x1217)  # domain-separated from train keys
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), _INIT_DOMAIN)
     if domain:
         base = jax.random.fold_in(base, domain)
     keys = _content_keys(base, kfold, genome_hashes)
@@ -1146,10 +1156,11 @@ class GeneticCnnModel(GentunModel):
         # holdout estimate with the CV estimate it is supposed to check.
         params = _init_population_params(
             model, stacked, cfg["input_shape"], pop, 1, cfg["seed"], hashes,
-            domain=0x5C04E,
+            domain=_HOLDOUT_DOMAIN,
         )
         keys = _content_keys(
-            jax.random.fold_in(jax.random.PRNGKey(cfg["seed"]), 0x5C04E), 1, hashes
+            jax.random.fold_in(jax.random.PRNGKey(cfg["seed"]), _HOLDOUT_DOMAIN),
+            1, hashes,
         )
         x_full = np.concatenate([x_tr, x_te], axis=0)
         y_full = np.concatenate([y_tr, y_te], axis=0)

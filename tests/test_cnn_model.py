@@ -593,3 +593,39 @@ class TestBatchCompositionPurity:
         assert h[0] == h[2] != h[1]
         # order of evaluation / position in the list is irrelevant
         assert _genome_hashes([g2, g1])[1] == h[0]
+
+    def test_key_stream_domains_are_separated(self):
+        """Init, CV-train, and holdout streams must never collide for one
+        (seed, genome) — without the domain folds, train_and_score under
+        the search's own seed would replicate CV fold-0 bit-for-bit and
+        correlate the holdout estimate with the CV estimate it checks.
+        Driven through the production constants and the production init
+        path, not re-derived folds."""
+        from gentun_tpu.models import cnn as cnn_mod
+        from gentun_tpu.models.cnn import (
+            MaskedGeneticCnn, _content_keys, _genome_hashes, _init_population_params,
+        )
+
+        assert cnn_mod._INIT_DOMAIN != cnn_mod._HOLDOUT_DOMAIN != 0
+        base = jax.random.PRNGKey(0)
+        h = _genome_hashes([{"S_1": (1, 0, 1)}])
+        train = np.asarray(_content_keys(base, 1, h))  # CV train keys, fold 0
+        init = np.asarray(_content_keys(jax.random.fold_in(base, cnn_mod._INIT_DOMAIN), 1, h))
+        holdout = np.asarray(_content_keys(
+            jax.random.fold_in(base, cnn_mod._HOLDOUT_DOMAIN), 1, h))
+        assert not (train == init).all()
+        assert not (train == holdout).all()
+        assert not (init == holdout).all()
+
+        # and the init entry point honors domain=: CV-init params vs
+        # holdout-init params differ for the same (seed, genome)
+        model = MaskedGeneticCnn(nodes=(3,), filters=(4,), dense_units=8,
+                                 n_classes=2, compute_dtype=jnp.float32)
+        masks = [{k: v for k, v in stage.items()}
+                 for stage in stack_genome_masks([{"S_1": (1, 0, 1)}], (3,))]
+        cv_params = _init_population_params(model, masks, (8, 8, 1), 1, 1, 0, h)
+        ho_params = _init_population_params(model, masks, (8, 8, 1), 1, 1, 0, h,
+                                            domain=cnn_mod._HOLDOUT_DOMAIN)
+        leaves_cv = jax.tree.leaves(cv_params)
+        leaves_ho = jax.tree.leaves(ho_params)
+        assert any(not np.array_equal(a, b) for a, b in zip(leaves_cv, leaves_ho))
