@@ -77,12 +77,22 @@ class GeneticAlgorithm:
         self.generation = 0
         self.history: List[Dict[str, Any]] = []
         self._checkpointer = None
+        self._fault_injector = None
 
     # -- checkpointing hook (wired by utils.checkpoint) --------------------
 
     def set_checkpointer(self, checkpointer) -> None:
         """Attach a generation-boundary checkpointer (``utils/checkpoint.py``)."""
         self._checkpointer = checkpointer
+
+    def set_fault_injector(self, injector) -> None:
+        """Attach a chaos-testing injector (``distributed/faults.py``).
+
+        Its only master-side hook is ``master_boundary``, fired AFTER the
+        generation checkpoint is written — a ``kill_master`` fault therefore
+        simulates a crash at the exact point resume is guaranteed from.
+        """
+        self._fault_injector = injector
 
     # -- selection ---------------------------------------------------------
 
@@ -122,20 +132,36 @@ class GeneticAlgorithm:
         self.generation += 1
         if self._checkpointer is not None:
             self._checkpointer.save(self)
+        if self._fault_injector is not None:
+            # After the checkpoint: a kill here is the recoverable crash.
+            self._fault_injector.master_boundary(self.generation)
 
-    def run(self, max_generations: int) -> Individual:
+    def run(self, max_generations: int, checkpointer=None) -> Individual:
         """Run the search; returns the final fittest individual.
 
         Matches the reference's entry point
-        ``GeneticAlgorithm(population).run(n)`` (SURVEY.md §3.1).
+        ``GeneticAlgorithm(population).run(n)`` (SURVEY.md §3.1):
+        ``max_generations`` means "N more generations from here".
+
+        With ``checkpointer`` (a ``utils/checkpoint.Checkpointer``), run
+        becomes crash-resumable: the checkpointer is attached, any existing
+        checkpoint is resumed first, and ``max_generations`` is the TOTAL
+        generation count for the search — a master killed at generation k
+        and re-run with the same arguments executes the remaining
+        ``max_generations - k`` and produces the identical trajectory.
         """
+        if checkpointer is not None:
+            self.set_checkpointer(checkpointer)
+            if checkpointer.resume(self):
+                logger.info("resumed from checkpoint at generation %d", self.generation)
+        remaining = max_generations - self.generation if checkpointer is not None else max_generations
         logger.info(
             "starting %s: population=%d, generations=%d",
             type(self).__name__,
             len(self.population),
-            max_generations,
+            remaining,
         )
-        for _ in range(max_generations):
+        for _ in range(max(remaining, 0)):
             self.evolve_population()
         self.population.evaluate()
         best = self.population.get_fittest()

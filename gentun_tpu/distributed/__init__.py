@@ -10,6 +10,7 @@ device collectives stay inside each worker (ICI, via jax).
 
 from .broker import GatherTimeout, JobBroker, JobFailed
 from .client import GentunClient
+from .faults import FaultInjector, FaultPlan, FaultSpec, MasterKilled
 from .protocol import AuthError
 from .server import DistributedGridPopulation, DistributedPopulation
 
@@ -21,4 +22,8 @@ __all__ = [
     "AuthError",
     "DistributedPopulation",
     "DistributedGridPopulation",
+    "FaultSpec",
+    "FaultPlan",
+    "FaultInjector",
+    "MasterKilled",
 ]

@@ -123,6 +123,10 @@ class JobBroker:
         Explicit worker-side ``fail`` replies per job before :meth:`gather`
         raises :class:`JobFailed`.  Worker *disconnects* never count (AMQP
         redelivers those indefinitely).
+    fault_injector:
+        Optional :class:`distributed.faults.FaultInjector` for deterministic
+        chaos testing.  ``None`` (the default) costs one attribute check per
+        frame and nothing else.
     """
 
     def __init__(
@@ -132,12 +136,14 @@ class JobBroker:
         token: Optional[str] = None,
         heartbeat_timeout: float = 15.0,
         max_attempts: int = 3,
+        fault_injector=None,
     ):
         self._host = host
         self._port = port
         self._token = token
         self._heartbeat_timeout = float(heartbeat_timeout)
         self._max_attempts = int(max_attempts)
+        self._injector = fault_injector
 
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -432,6 +438,24 @@ class JobBroker:
         with self._cond:
             return max(self._chips_seen, self.fleet_chips())
 
+    def outstanding(self) -> Dict[str, int]:
+        """Sizes of every master-side job-state structure; all zero ⇔ the
+        broker is quiescent (no open jobs, no undelivered results, no
+        attempt counts).  The chaos suite asserts this after every final
+        gather: at-least-once redelivery + dedup must leave ZERO state
+        behind whatever faults fired mid-search.  Snapshot read (len only),
+        safe from any thread.
+        """
+        with self._cond:
+            results, failures = len(self._results), len(self._failures)
+        return {
+            "payloads": len(self._payloads),
+            "pending": len(self._pending),
+            "fail_counts": len(self._fail_counts),
+            "results": results,
+            "failures": failures,
+        }
+
     @staticmethod
     def new_job_id() -> str:
         return uuid.uuid4().hex
@@ -476,6 +500,8 @@ class JobBroker:
 
     def _send(self, w: _Worker, msg: Dict[str, Any]) -> None:
         try:
+            if self._injector is not None and self._injector.broker_send(w, msg):
+                return
             w.writer.write(encode(msg))
         except Exception:  # connection already broken; reader will clean up
             logger.debug("write to worker %s failed", w.worker_id, exc_info=True)
@@ -554,6 +580,13 @@ class JobBroker:
                 if not line:
                     break  # EOF: worker gone
                 msg = decode(line)
+                if self._injector is not None:
+                    # May delay, raise ProtocolError (corrupt), or close the
+                    # connection and return None (drop_connection) — in which
+                    # case the reader's EOF path runs the normal cleanup.
+                    msg = self._injector.broker_recv(worker, msg)
+                    if msg is None:
+                        continue
                 worker.last_seen = time.monotonic()
                 mtype = msg["type"]
                 if mtype == "ping":

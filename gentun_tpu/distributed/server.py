@@ -66,6 +66,9 @@ class DistributedPopulation(Population):
       already-measured genomes ships ZERO jobs.  The store rides
       ``clone_with``, so closing whichever generation's population the
       caller ends up holding saves every fitness the search measured.
+    - ``fault_injector``: chaos testing (``distributed/faults.py``).
+      Passed through to an owned :class:`JobBroker`; ignored when an
+      external ``broker`` is shared (inject on that broker directly).
     """
 
     def __init__(
@@ -92,6 +95,7 @@ class DistributedPopulation(Population):
         failed_policy: str = "raise",
         fitness_store: Optional[str] = None,
         speculative_fill=False,
+        fault_injector=None,
     ):
         if failed_policy not in ("raise", "penalize"):
             raise ValueError(f"unknown failed_policy {failed_policy!r}")
@@ -139,6 +143,7 @@ class DistributedPopulation(Population):
                 token=password,
                 heartbeat_timeout=heartbeat_timeout,
                 max_attempts=max_attempts,
+                fault_injector=fault_injector,
             ).start()
             self._owns_broker = True
 

@@ -126,6 +126,9 @@ def main(argv=None) -> int:
                          "JSON): jobs whose genes+config were measured by a prior "
                          "run are answered without retraining.  Not available with "
                          "--coordinator (multihost) — see GentunClient.")
+    ap.add_argument("--fault-plan", default=None, metavar="PATH",
+                    help="chaos testing: JSON FaultPlan (distributed/faults.py) "
+                         "injected into this worker's client hooks")
     mh = ap.add_argument_group(
         "multi-host",
         "run ONE logical worker across a multi-process jax cluster (e.g. all "
@@ -168,6 +171,16 @@ def main(argv=None) -> int:
     from .client import GentunClient
     from .protocol import AuthError
 
+    injector = None
+    if args.fault_plan is not None:
+        from .faults import FaultInjector, FaultPlan
+
+        with open(args.fault_plan, "r", encoding="utf-8") as fh:
+            injector = FaultInjector(FaultPlan.from_json(fh.read()))
+        logging.getLogger("gentun_tpu.distributed").warning(
+            "fault injection ACTIVE: %d spec(s) from %s", len(injector.plan.specs), args.fault_plan
+        )
+
     client = GentunClient(
         _species(args.species),
         x,
@@ -180,6 +193,7 @@ def main(argv=None) -> int:
         multihost=multihost,
         n_chips=args.n_chips,
         fitness_store=args.fitness_store,
+        fault_injector=injector,
     )
     try:
         done = client.work(max_jobs=args.max_jobs)

@@ -49,9 +49,9 @@ TPU-first architecture (NOT how the reference does it — SURVEY.md §7
 from __future__ import annotations
 
 import functools
+import hashlib
 import logging
 import weakref
-import zlib
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -469,7 +469,7 @@ def _init_fn(model: MaskedGeneticCnn, input_shape: Tuple[int, ...]):
 
 
 def _genome_hashes(genomes: Sequence[Mapping[str, Any]]) -> np.ndarray:
-    """Stable per-genome content hash (int32) for PRNG key derivation.
+    """Stable per-genome 64-bit content hash, shape (n, 2) uint32, for PRNG keys.
 
     Folding each population slot's keys from the genome CONTENT instead of
     the slot index makes fitness a pure function of (architecture, config,
@@ -483,28 +483,41 @@ def _genome_hashes(genomes: Sequence[Mapping[str, Any]]) -> np.ndarray:
     can still reorder float reductions, but per-slot math is slot-local;
     in practice fitnesses now match bit-for-bit across batch shapes —
     asserted by ``tests/test_cnn_model.py::TestBatchCompositionPurity``.)
+
+    blake2b(digest_size=8) rather than CRC32: two distinct architectures
+    colliding share init/dropout streams, and a 31-bit space makes that
+    a ~2% event at 10k genomes (birthday bound).  The 64-bit digest is
+    split into (hi, lo) uint32 words, each folded into the key separately
+    (``_content_keys``), pushing collisions to ~3e-12 at the same scale.
+    Widening the hash changes every measured fitness value, hence
+    ``FITNESS_PROTOCOL`` 3 (utils/fitness_store.py).
     """
-    out = np.empty(len(genomes), dtype=np.int64)
+    out = np.empty((len(genomes), 2), dtype=np.uint32)
     for i, g in enumerate(genomes):
-        crc = 0
+        h = hashlib.blake2b(digest_size=8)
         for k in sorted(g):
             arr = np.asarray(g[k])
             arr = arr.astype(np.int64) if arr.dtype.kind in "biu" else arr.astype(np.float64)
-            crc = zlib.crc32(str(k).encode(), crc)
-            crc = zlib.crc32(str(arr.shape).encode(), crc)
-            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
-        out[i] = crc & 0x7FFFFFFF
-    return out.astype(np.int32)
+            h.update(str(k).encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        digest = int.from_bytes(h.digest(), "little")
+        out[i, 0] = digest >> 32  # hi word
+        out[i, 1] = digest & 0xFFFFFFFF  # lo word
+    return out
 
 
 def _content_keys(base_key, kfold: int, genome_hashes) -> jnp.ndarray:
-    """(kfold, P, 2) PRNG keys: fold index then genome content folded in."""
-    h = jnp.asarray(genome_hashes)
+    """(kfold, P, 2) PRNG keys: fold index then the 64-bit genome content
+    hash — as two uint32 words — folded in."""
+    h = jnp.asarray(genome_hashes)  # (P, 2) uint32
+
+    def fold(hh, f):
+        k = jax.random.fold_in(base_key, f)
+        return jax.random.fold_in(jax.random.fold_in(k, hh[0]), hh[1])
+
     return jnp.stack(
-        [
-            jax.vmap(lambda hh, f=f: jax.random.fold_in(jax.random.fold_in(base_key, f), hh))(h)
-            for f in range(kfold)
-        ]
+        [jax.vmap(lambda hh, f=f: fold(hh, f))(h) for f in range(kfold)]
     )
 
 

@@ -31,6 +31,22 @@ reused for identical training configurations; a changed schedule or
 dataset size produces disjoint keys.  Changed dataset CONTENT under the
 same configuration is the caller's responsibility, exactly as with the
 reference's in-memory cache — keep one file per dataset.
+
+**Mixed-version fleets: all writers upgrade together.**  The payload
+carries a ``version`` (file schema) besides ``protocol`` (fitness
+semantics).  Writers REFUSE files whose version exceeds their own
+``STORE_VERSION`` — refusing is the only safe move, because an older
+writer's read-merge-write cycle would load a newer file as empty (its
+loader ignores unknown protocols) and then rewrite it, silently
+destroying every newer-protocol entry under the old stamp.  Readers
+likewise ignore newer files rather than guessing at their schema.  The
+consequence is operational, not mechanical: when a store file is shared
+between machines (workers with ``--fitness-store``, masters with
+``fitness_store=``), upgrade every writer to the same code revision
+before any of them runs — a mixed fleet degrades to refusals (loud, no
+data loss on the new side) but pre-``STORE_VERSION``-aware writers
+(version 1) predate this guard and WILL clobber newer files; do not
+point them at a shared store.
 """
 
 from __future__ import annotations
@@ -43,7 +59,7 @@ from typing import Any, Dict
 
 __all__ = [
     "load_fitness_cache", "save_fitness_cache", "tuplify",
-    "is_serializable_key", "FITNESS_PROTOCOL",
+    "is_serializable_key", "FITNESS_PROTOCOL", "STORE_VERSION",
 ]
 
 #: Fitness-measurement RNG protocol.  Bump whenever a model's fitness for
@@ -54,8 +70,16 @@ __all__ = [
 #:   1 — per-slot PRNG keys (``split(PRNGKey(seed+f), pop)``), rounds 1-4:
 #:       fitness depended on batch slot/composition;
 #:   2 — content-hash keys (``models/cnn._genome_hashes``), round 5:
-#:       fitness is a pure function of (architecture, config, seed).
-FITNESS_PROTOCOL = 2
+#:       fitness is a pure function of (architecture, config, seed);
+#:   3 — 64-bit content hashes (blake2b split across two fold_in calls),
+#:       round 6: init/dropout streams collision-free at 10k+ genomes.
+FITNESS_PROTOCOL = 3
+
+#: File-schema version.  Bump together with any payload change; writers
+#: refuse files with a NEWER version (see module docstring — an older
+#: writer merging a newer file would load it as empty and clobber it).
+#: History: 1 — original payload; 2 — version guard introduced.
+STORE_VERSION = 2
 
 
 def tuplify(obj: Any) -> Any:
@@ -121,6 +145,18 @@ def load_fitness_cache(path: str) -> Dict[Any, float]:
     try:
         with open(path) as f:
             payload = json.load(f)
+        version = payload.get("version", 1)
+        if version > STORE_VERSION:
+            import logging
+
+            logging.getLogger("gentun_tpu").warning(
+                "fitness store %s has file-schema version %s, newer than "
+                "this writer's %s; IGNORING it — upgrade this process "
+                "before sharing the store (see utils/fitness_store.py).  "
+                "The file is left untouched.",
+                path, version, STORE_VERSION,
+            )
+            return {}
         proto = payload.get("protocol", 1)
         if proto != FITNESS_PROTOCOL:
             import logging
@@ -162,13 +198,33 @@ def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)  # before locking: works with or without fcntl
     with _file_lock(path):
+        # A newer-versioned file must not be rewritten: our loader reads it
+        # as empty, so the merge below would atomically replace it with only
+        # this process's entries — destroying the newer fleet's measurements.
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    existing_version = json.load(f).get("version", 1)
+            except (ValueError, AttributeError):
+                existing_version = 1  # corrupt: load() quarantines it below
+            if existing_version > STORE_VERSION:
+                import logging
+
+                logging.getLogger("gentun_tpu").error(
+                    "REFUSING to save fitness store %s: its file-schema "
+                    "version %s is newer than this writer's %s.  Upgrade "
+                    "this process, or point it at a different store file; "
+                    "these measurements were NOT persisted.",
+                    path, existing_version, STORE_VERSION,
+                )
+                return 0
         merged = load_fitness_cache(path)
         for k, v in cache.items():
             if not is_serializable_key(k):
                 continue
             merged[k] = float(v)
         payload = {
-            "version": 1,
+            "version": STORE_VERSION,
             "protocol": FITNESS_PROTOCOL,
             "entries": [[k, v] for k, v in merged.items()],
         }

@@ -1,0 +1,187 @@
+"""Measured chaos artifact: a full distributed search under a composed
+fault plan, compared bit-for-bit against the clean run.
+
+DISTRIBUTED.md records the happy path (0 retries, 0 requeues); this
+script records the UNHAPPY path the same way — a seeded 2-worker search
+surviving a worker kill mid-batch, a corrupt frame, an injected eval
+failure, a hung worker (reaped + redelivered), a duplicated result
+(dropped), and a master kill/resume at a generation boundary — and
+asserts the headline invariant: identical best-fitness history,
+evaluated-architecture set, and final population versus the fault-free
+run, with zero leaked broker state.
+
+CPU-only, a few seconds: `python scripts/chaos_run.py` writes
+``scripts/chaos_run.json``.  The plan is serialized into the artifact, so
+a recorded run can be replayed exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome  # noqa: E402
+from gentun_tpu.distributed import (  # noqa: E402
+    DistributedPopulation,
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    GentunClient,
+    MasterKilled,
+)
+from gentun_tpu.utils import Checkpointer  # noqa: E402
+
+GENERATIONS = 5
+POP_SIZE = 8
+POP_SEED, GA_SEED = 42, 7
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+class OneMax(Individual):
+    """Pure deterministic fitness — count of set bits — so local and
+    distributed runs are comparable bit-for-bit."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+def _free_port() -> int:
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+def _worker(port, injector=None, worker_id=None):
+    stop = threading.Event()
+    client = GentunClient(
+        OneMax, *DATA, host="127.0.0.1", port=port, worker_id=worker_id,
+        heartbeat_interval=0.2, reconnect_delay=0.05, reconnect_max_delay=0.5,
+        fault_injector=injector,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return stop
+
+
+def _snapshot(ga):
+    return {
+        "best_fitness_history": [r["best_fitness"] for r in ga.history],
+        "final_population": [
+            {"genes": {k: list(v) for k, v in ind.get_genes().items()},
+             "fitness": ind.get_fitness()}
+            for ind in ga.population
+        ],
+        "n_architectures_evaluated": len(ga.population.fitness_cache),
+    }
+
+
+def run() -> dict:
+    # -- clean reference (single-process; OneMax purity makes it comparable)
+    clean = GeneticAlgorithm(
+        Population(OneMax, *DATA, size=POP_SIZE, seed=POP_SEED), seed=GA_SEED)
+    clean.run(GENERATIONS)
+
+    # -- the composed plan: every fault kind, against a live search --------
+    worker_plan = FaultPlan([
+        FaultSpec(hook="client_send", kind="drop_connection", match_type="result", at=0),
+        FaultSpec(hook="client_send", kind="corrupt", match_type="result", at=3),
+        FaultSpec(hook="client_send", kind="duplicate_result", match_type="result", at=6),
+        FaultSpec(hook="client_recv", kind="delay", at=2, delay=0.05),
+        FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=1),
+        FaultSpec(hook="worker_pre_eval", kind="hang", at=8, duration=2.5),
+    ], seed=2026)
+    master_plan = FaultPlan([
+        FaultSpec(hook="master_boundary", kind="kill_master", generation=2),
+    ], seed=2026)
+
+    w0_inj = FaultInjector(worker_plan)
+    kill_inj = FaultInjector(master_plan)
+
+    port = _free_port()
+    ckpt_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".chaos_ckpt.json")
+    if os.path.exists(ckpt_path):
+        os.unlink(ckpt_path)
+    stops = [_worker(port, injector=w0_inj, worker_id="chaos-w0"),
+             _worker(port, worker_id="clean-w1")]
+
+    t0 = time.monotonic()
+    master_killed_at = None
+    try:
+        # Act 1: chaos until the injected master death.
+        pop_a = DistributedPopulation(
+            OneMax, size=POP_SIZE, seed=POP_SEED, host="127.0.0.1", port=port,
+            job_timeout=120, heartbeat_timeout=1.0)
+        try:
+            ga_a = GeneticAlgorithm(pop_a, seed=GA_SEED)
+            ga_a.set_fault_injector(kill_inj)
+            try:
+                ga_a.run(GENERATIONS, checkpointer=Checkpointer(ckpt_path))
+                raise AssertionError("kill_master never fired")
+            except MasterKilled as e:
+                master_killed_at = e.generation
+        finally:
+            pop_a.close()
+
+        # Act 2: reborn master, same port, auto-resume, run to completion.
+        pop_b = DistributedPopulation(
+            OneMax, size=POP_SIZE, seed=0, host="127.0.0.1", port=port,
+            job_timeout=120, heartbeat_timeout=1.0)
+        try:
+            ga_b = GeneticAlgorithm(pop_b, seed=0)
+            ga_b.run(GENERATIONS, checkpointer=Checkpointer(ckpt_path))
+            wall = time.monotonic() - t0
+            chaos_snap = _snapshot(ga_b)
+            leaked = ga_b.population.broker.outstanding()
+        finally:
+            ga_b.population.close()
+            pop_b.close()
+    finally:
+        for s in stops:
+            s.set()
+        if os.path.exists(ckpt_path):
+            os.unlink(ckpt_path)
+
+    clean_snap = _snapshot(clean)
+    fired = list(w0_inj.fired) + list(kill_inj.fired)
+    identical = clean_snap == chaos_snap
+    assert identical, "chaos run diverged from the clean run"
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+    kinds_fired = sorted({f["kind"] for f in fired})
+    return {
+        "generations": GENERATIONS,
+        "population_size": POP_SIZE,
+        "seeds": {"population": POP_SEED, "ga": GA_SEED},
+        "workers": 2,
+        "fault_plan": {"worker0": worker_plan.to_dict(), "master": master_plan.to_dict()},
+        "faults_fired": fired,
+        "fault_kinds_fired": kinds_fired,
+        "master_killed_at_generation": master_killed_at,
+        "bit_identical_to_clean_run": identical,
+        "broker_state_after_final_gather": leaked,
+        "best_fitness_history": chaos_snap["best_fitness_history"],
+        "n_architectures_evaluated": chaos_snap["n_architectures_evaluated"],
+        "chaos_wall_s": round(wall, 3),
+    }
+
+
+if __name__ == "__main__":
+    out = run()
+    print(json.dumps(out, indent=2))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}")

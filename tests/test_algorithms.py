@@ -199,7 +199,9 @@ def test_old_fitness_protocol_checkpoint_drops_measurements(caplog):
     ga = GeneticAlgorithm(pop, seed=1)
     ga.evolve_population()
     state = ga.state_dict()
-    assert state["fitness_protocol"] == 2
+    from gentun_tpu.utils.fitness_store import FITNESS_PROTOCOL
+
+    assert state["fitness_protocol"] == FITNESS_PROTOCOL
     assert any(i["fitness"] is not None for i in state["population"]["individuals"])
     state["fitness_protocol"] = 1  # simulate a round-4-era checkpoint
 

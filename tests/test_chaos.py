@@ -1,0 +1,473 @@
+"""Chaos suite: the distributed plane under deterministic fault injection.
+
+The hardware artifacts in DISTRIBUTED.md record 0 retries / 0 requeues —
+the failure machinery (reaper, redelivery, duplicate-result drop,
+checkpoint resume) had only ever been unit-poked.  These tests drive the
+WHOLE stack through seeded ``FaultPlan`` schedules and assert the strong
+invariant the content-hash purity work (round 5) makes possible: a search
+that survives worker crashes, partitions, corrupt frames, and a master
+kill produces a **bit-identical trajectory** to the fault-free run.
+
+Layout:
+
+- ``TestFaultPlan`` / ``TestReconnectBackoff`` / ``TestZeroCost`` — unit
+  coverage of the new pieces, always on.
+- ``TestChaosSmoke`` — one drop + one fail-eval scenario, always on
+  (tier-1's canary that the broker/client handling didn't regress).
+- ``TestChaosMatrix`` — the full fault-kind × phase matrix, ``slow``.
+- ``TestChaosE2E`` — the headline: seeded 2-worker search under a
+  composed plan (worker kill mid-batch, forced redelivery, master
+  kill/resume at a generation boundary) vs. the clean run.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import (
+    DistributedPopulation,
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    GentunClient,
+    JobBroker,
+    MasterKilled,
+)
+from gentun_tpu.distributed.client import _ReconnectBackoff
+from gentun_tpu.distributed.faults import _HOOK_KINDS, HOOKS, KINDS
+from gentun_tpu.utils import Checkpointer
+
+
+class OneMax(Individual):
+    """Cheap deterministic fitness: count of set bits (pure function of
+    genes, so local and distributed evaluation agree bit-for-bit)."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+def _free_port() -> int:
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+def _start_worker(port, injector=None, worker_id=None, capacity=1):
+    """Worker thread with chaos-friendly timings (fast heartbeat, fast
+    reconnect with a tight cap so injected drops cost milliseconds)."""
+    stop = threading.Event()
+    client = GentunClient(
+        OneMax, *DATA, host="127.0.0.1", port=port,
+        capacity=capacity, worker_id=worker_id,
+        heartbeat_interval=0.2, reconnect_delay=0.05, reconnect_max_delay=0.5,
+        fault_injector=injector,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return stop, t
+
+
+def _expected_fitnesses(pop):
+    return [float(sum(sum(g) for g in ind.genes.values())) for ind in pop]
+
+
+def _assert_quiescent(broker: JobBroker):
+    out = broker.outstanding()
+    assert all(v == 0 for v in out.values()), f"leaked broker state: {out}"
+
+
+def _run_scenario(specs, broker_specs=(), size=6, seed=3, n_workers=1,
+                  heartbeat_timeout=15.0, **pop_kw):
+    """Evaluate one distributed population with worker 0 under ``specs``
+    and the broker under ``broker_specs``; assert the three invariants
+    every recoverable fault must preserve: correct fitnesses, a quiescent
+    broker, and a plan that actually fired."""
+    inj = FaultInjector(FaultPlan([FaultSpec(**s) for s in specs]))
+    broker_inj = (
+        FaultInjector(FaultPlan([FaultSpec(**s) for s in broker_specs]))
+        if broker_specs else None
+    )
+    pop = DistributedPopulation(
+        OneMax, size=size, seed=seed, port=0, job_timeout=60,
+        heartbeat_timeout=heartbeat_timeout, fault_injector=broker_inj,
+        **pop_kw,
+    )
+    stops = []
+    try:
+        _, port = pop.broker_address
+        stops.append(_start_worker(port, injector=inj, worker_id="chaos-w0")[0])
+        for i in range(1, n_workers):
+            stops.append(_start_worker(port, worker_id=f"clean-w{i}")[0])
+        pop.evaluate()
+        assert [ind.get_fitness() for ind in pop] == _expected_fitnesses(pop)
+        _assert_quiescent(pop.broker)
+        fired = list(inj.fired) + (list(broker_inj.fired) if broker_inj else [])
+        assert fired, "fault plan never fired — the scenario tested nothing"
+        return fired
+    finally:
+        for s in stops:
+            s.set()
+        pop.close()
+
+
+class TestFaultPlan:
+    def test_spec_rejects_unknown_hook(self):
+        with pytest.raises(ValueError, match="unknown hook"):
+            FaultSpec(hook="nope", kind="delay")
+
+    def test_spec_rejects_unknown_kind(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            FaultSpec(hook="client_send", kind="nope")
+
+    def test_spec_rejects_kind_hook_mismatch(self):
+        # fail_eval only makes sense inside the evaluation, not on the wire
+        with pytest.raises(ValueError, match="not injectable"):
+            FaultSpec(hook="client_send", kind="fail_eval")
+
+    def test_spec_rejects_bad_counters(self):
+        with pytest.raises(ValueError):
+            FaultSpec(hook="client_send", kind="delay", at=-1)
+        with pytest.raises(ValueError):
+            FaultSpec(hook="client_send", kind="delay", times=0)
+
+    def test_hook_kind_table_is_total(self):
+        assert set(_HOOK_KINDS) == set(HOOKS)
+        assert set(KINDS) == {k for ks in _HOOK_KINDS.values() for k in ks}
+
+    def test_plan_json_round_trip(self):
+        plan = FaultPlan(
+            [
+                FaultSpec(hook="client_send", kind="duplicate_result",
+                          match_type="result", at=2, times=3),
+                FaultSpec(hook="master_boundary", kind="kill_master", generation=4),
+            ],
+            seed=99,
+        )
+        back = FaultPlan.from_json(plan.to_json())
+        assert back.seed == 99
+        assert [s.to_dict() for s in back.specs] == [s.to_dict() for s in plan.specs]
+
+    def test_sample_is_deterministic_per_seed(self):
+        a = FaultPlan.sample(123, n_faults=6)
+        b = FaultPlan.sample(123, n_faults=6)
+        c = FaultPlan.sample(124, n_faults=6)
+        assert a.to_dict() == b.to_dict()
+        assert a.to_dict() != c.to_dict()
+
+    def test_sample_respects_hook_pool(self):
+        plan = FaultPlan.sample(0, n_faults=8, hooks=("worker_pre_eval",))
+        assert {s.hook for s in plan.specs} == {"worker_pre_eval"}
+        # default pool excludes master_boundary (needs a resume harness)
+        assert all(s.hook != "master_boundary" for s in FaultPlan.sample(1, 16).specs)
+
+
+class TestReconnectBackoff:
+    def test_delays_bounded_and_first_is_base(self):
+        b = _ReconnectBackoff(0.1, 2.0, "w1")
+        delays = [b.next_delay() for _ in range(50)]
+        assert delays[0] == 0.1
+        assert all(0.1 <= d <= 2.0 for d in delays)
+        assert max(delays) > 0.5  # it actually backs off toward the cap
+
+    def test_reset_rearms_base(self):
+        b = _ReconnectBackoff(0.1, 2.0, "w1")
+        for _ in range(10):
+            b.next_delay()
+        b.reset()
+        assert b.next_delay() == 0.1
+
+    def test_deterministic_per_worker_id(self):
+        a = _ReconnectBackoff(0.1, 2.0, "w1")
+        b = _ReconnectBackoff(0.1, 2.0, "w1")
+        assert [a.next_delay() for _ in range(10)] == [b.next_delay() for _ in range(10)]
+
+    def test_decorrelated_across_fleet(self):
+        # a fixed delay synchronizes a reconnect stampede; distinct worker
+        # ids must yield distinct jitter streams
+        a = _ReconnectBackoff(0.1, 2.0, "w1")
+        b = _ReconnectBackoff(0.1, 2.0, "w2")
+        assert [a.next_delay() for _ in range(10)] != [b.next_delay() for _ in range(10)]
+
+    def test_degenerate_params_clamped(self):
+        b = _ReconnectBackoff(0.0, 0.0, "w")
+        assert 0 < b.next_delay() <= 1e-3
+
+
+class TestZeroCost:
+    """Acceptance criterion: fault injection is provably free when off —
+    the default injector is None everywhere, and the hot path guards on a
+    single attribute check (no allocation, no no-op object)."""
+
+    def test_default_injectors_are_none(self):
+        broker = JobBroker(port=0)
+        assert broker._injector is None
+        client = GentunClient(OneMax, *DATA)
+        assert client._injector is None
+        ga = GeneticAlgorithm(Population(OneMax, *DATA, size=2, seed=0), seed=0)
+        assert ga._fault_injector is None
+
+    def test_distributed_population_default_is_none(self):
+        pop = DistributedPopulation(OneMax, size=2, seed=0, port=0)
+        try:
+            assert pop.broker._injector is None
+        finally:
+            pop.close()
+
+
+class TestChaosSmoke:
+    """Always-on canary: one connection drop + one eval failure.  Each
+    would hang or corrupt the search if the broker/client handling
+    (requeue-on-disconnect, fail-reply redelivery) regressed."""
+
+    def test_drop_connection_mid_batch(self):
+        # the worker dies exactly when sending its first result: the broker
+        # must requeue the lost job and the reconnected worker must finish
+        fired = _run_scenario(
+            [dict(hook="client_send", kind="drop_connection", match_type="result", at=0)],
+        )
+        assert any(f["kind"] == "drop_connection" for f in fired)
+
+    def test_fail_eval_redelivers(self):
+        # first evaluation raises; the fail reply must requeue the job and
+        # the retry (attempt 2 of max_attempts=3) must succeed
+        fired = _run_scenario(
+            [dict(hook="worker_pre_eval", kind="fail_eval", at=0)],
+        )
+        assert any(f["kind"] == "fail_eval" for f in fired)
+
+
+@pytest.mark.slow
+class TestChaosMatrix:
+    """Fault kind × phase scenarios (curated, not a blind cross-product:
+    e.g. `hang` during a handshake is not a distinct state — the worker
+    holds no jobs yet).  Every fault kind in faults.py appears here or in
+    the smoke/E2E tests, against the layer that must absorb it."""
+
+    # -- corrupt ----------------------------------------------------------
+
+    def test_corrupt_jobs_frame_from_broker(self):
+        # mid-batch, broker→client direction: the client's ProtocolError
+        # path must tear down and recover exactly like a disconnect
+        fired = _run_scenario(
+            [], broker_specs=[dict(hook="broker_send", kind="corrupt", match_type="jobs", at=0)],
+        )
+        assert any(f["kind"] == "corrupt" for f in fired)
+
+    def test_corrupt_result_frame_from_client(self):
+        # client→broker direction: the broker must drop the connection,
+        # requeue, and accept the redelivered result
+        fired = _run_scenario(
+            [dict(hook="client_send", kind="corrupt", match_type="result", at=0)],
+        )
+        assert any(f["kind"] == "corrupt" for f in fired)
+
+    def test_corrupt_welcome_during_handshake(self):
+        # during-handshake: the FIRST broker frame the client ever reads
+        # is garbage; the reconnect loop must retry and complete
+        fired = _run_scenario(
+            [dict(hook="client_recv", kind="corrupt", match_type="welcome", at=0)],
+        )
+        assert any(f["kind"] == "corrupt" for f in fired)
+
+    # -- drop-connection --------------------------------------------------
+
+    def test_drop_at_barrier_broker_side(self):
+        # the broker hangs up on the worker right as it delivers jobs; the
+        # requeue-on-disconnect path must redeliver after reconnect
+        fired = _run_scenario(
+            [], broker_specs=[dict(hook="broker_send", kind="drop_connection",
+                                   match_type="jobs", at=0)],
+        )
+        assert any(f["kind"] == "drop_connection" for f in fired)
+
+    def test_connect_refused_during_handshake(self):
+        # the first TWO connection attempts are refused; backoff + retry
+        fired = _run_scenario(
+            [dict(hook="client_connect", kind="drop_connection", at=0, times=2)],
+        )
+        assert sum(f["kind"] == "drop_connection" for f in fired) == 2
+
+    def test_drop_ready_frame_recv_side(self):
+        # broker-recv direction: the worker's `ready` frame is swallowed
+        # and its connection torn down — redelivery must still occur
+        fired = _run_scenario(
+            [], broker_specs=[dict(hook="broker_recv", kind="drop_connection",
+                                   match_type="ready", at=1)],
+        )
+        assert any(f["kind"] == "drop_connection" for f in fired)
+
+    # -- delay ------------------------------------------------------------
+
+    def test_delays_are_invisible(self):
+        # latency at every wire hook must not change the outcome
+        fired = _run_scenario(
+            [
+                dict(hook="client_send", kind="delay", at=0, times=2, delay=0.1),
+                dict(hook="client_recv", kind="delay", at=0, delay=0.1),
+                dict(hook="client_connect", kind="delay", at=0, delay=0.1),
+            ],
+            broker_specs=[dict(hook="broker_send", kind="delay", at=0, delay=0.1)],
+        )
+        assert sum(f["kind"] == "delay" for f in fired) >= 4
+
+    # -- hang -------------------------------------------------------------
+
+    def test_hang_mid_batch_reaped_and_redelivered(self):
+        # worker 0 goes silent for 2.5 s holding a job; with a 1 s
+        # heartbeat timeout the reaper must declare it dead and redeliver
+        # (to the clean worker 1, or to worker 0 after it reconnects)
+        fired = _run_scenario(
+            [dict(hook="worker_pre_eval", kind="hang", at=1, duration=2.5)],
+            n_workers=2, heartbeat_timeout=1.0,
+        )
+        assert any(f["kind"] == "hang" for f in fired)
+
+    # -- duplicate-result -------------------------------------------------
+
+    def test_duplicate_result_counted_once(self):
+        # the replayed twin frame must be dropped by the broker's
+        # _payloads-membership dedup, not double-applied
+        fired = _run_scenario(
+            [dict(hook="client_send", kind="duplicate_result", match_type="result",
+                  at=0, times=2)],
+        )
+        assert sum(f["kind"] == "duplicate_result" for f in fired) == 2
+
+    # -- composed ---------------------------------------------------------
+
+    def test_sampled_plan_soak(self):
+        # a seeded random plan over the client hooks: whatever it draws,
+        # the invariants must hold (this is the replayable soak entry
+        # point — same seed, same schedule, bit-identical run)
+        plan = FaultPlan.sample(2026, n_faults=5,
+                                hooks=("client_send", "client_recv", "worker_pre_eval"))
+        # keep hangs short so the soak stays bounded
+        for s in plan.specs:
+            s.duration = min(s.duration, 1.5)
+        fired = _run_scenario([s.to_dict() for s in plan.specs],
+                              n_workers=2, heartbeat_timeout=1.0)
+        assert fired
+
+
+class TestChaosE2E:
+    """The acceptance headline: a seeded 2-worker search under a composed
+    fault plan — worker kill mid-batch, forced redelivery, and a master
+    kill/resume at a generation boundary — produces the same best-fitness
+    history, evaluated-architecture set, and final population as the
+    clean run, with zero leaked broker state."""
+
+    GENERATIONS = 4
+
+    def _clean_run(self):
+        ga = GeneticAlgorithm(Population(OneMax, *DATA, size=6, seed=42), seed=7)
+        ga.run(self.GENERATIONS)
+        return ga
+
+    def test_composed_chaos_run_is_bit_identical(self, tmp_path):
+        clean = self._clean_run()
+
+        ckpt = Checkpointer(str(tmp_path / "chaos-ckpt.json"))
+        port = _free_port()  # fixed so workers survive the master's death
+
+        # worker 0 carries the client-side chaos: a kill mid-batch (drops
+        # the connection while sending its first result) and a forced
+        # redelivery (its third evaluation raises)
+        w0_inj = FaultInjector(FaultPlan([
+            FaultSpec(hook="client_send", kind="drop_connection",
+                      match_type="result", at=0),
+            FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=2),
+        ]))
+        # the master dies at the generation-2 boundary (checkpoint written)
+        kill_inj = FaultInjector(FaultPlan([
+            FaultSpec(hook="master_boundary", kind="kill_master", generation=2),
+        ]))
+
+        stop0, _ = _start_worker(port, injector=w0_inj, worker_id="chaos-w0")
+        stop1, _ = _start_worker(port, worker_id="clean-w1")
+        try:
+            # Act 1: search under chaos until the master is killed.
+            pop_a = DistributedPopulation(
+                OneMax, size=6, seed=42, host="127.0.0.1", port=port, job_timeout=60)
+            try:
+                ga_a = GeneticAlgorithm(pop_a, seed=7)
+                ga_a.set_fault_injector(kill_inj)
+                with pytest.raises(MasterKilled) as exc:
+                    ga_a.run(self.GENERATIONS, checkpointer=ckpt)
+                assert exc.value.generation == 2
+            finally:
+                pop_a.close()  # the "crash" takes the broker down with it
+            del ga_a, pop_a
+
+            # Act 2: reborn master on the same port auto-resumes and
+            # completes against the still-running workers.
+            pop_b = DistributedPopulation(
+                OneMax, size=6, seed=0, host="127.0.0.1", port=port, job_timeout=60)
+            try:
+                ga_b = GeneticAlgorithm(pop_b, seed=0)
+                best = ga_b.run(self.GENERATIONS, checkpointer=ckpt)
+
+                # identical best-fitness history, generation by generation
+                assert [r["best_fitness"] for r in ga_b.history] == \
+                       [r["best_fitness"] for r in clean.history]
+                # identical evaluated-architecture set (fitness-cache keys)
+                assert set(ga_b.population.fitness_cache) == \
+                       set(clean.population.fitness_cache)
+                # identical final population, genes and fitnesses
+                assert [(i.get_genes(), i.get_fitness()) for i in ga_b.population] == \
+                       [(i.get_genes(), i.get_fitness()) for i in clean.population]
+                assert best.get_fitness() == clean.population.get_fittest().get_fitness()
+                # at-least-once + dedup left nothing behind
+                _assert_quiescent(ga_b.population.broker)
+            finally:
+                ga_b.population.close()
+                pop_b.close()
+        finally:
+            stop0.set()
+            stop1.set()
+
+        # the plan actually executed: both client faults and the kill fired
+        kinds = {f["kind"] for f in w0_inj.fired} | {f["kind"] for f in kill_inj.fired}
+        assert {"drop_connection", "fail_eval", "kill_master"} <= kinds
+
+    def test_run_with_checkpointer_totals_generations(self, tmp_path):
+        """Satellite: Checkpointer.resume through the distributed path with
+        run(total, checkpointer=) — master killed between generations,
+        resumed against a still-running worker, no manual resume calls."""
+        path = str(tmp_path / "resume-ckpt.json")
+        port = _free_port()
+        stop, _ = _start_worker(port, worker_id="resume-w0")
+        try:
+            pop_a = DistributedPopulation(OneMax, size=4, seed=5, port=port, job_timeout=60)
+            try:
+                ga_a = GeneticAlgorithm(pop_a, seed=5)
+                ga_a.set_fault_injector(FaultInjector(FaultPlan([
+                    FaultSpec(hook="master_boundary", kind="kill_master", generation=1),
+                ])))
+                with pytest.raises(MasterKilled):
+                    ga_a.run(3, checkpointer=Checkpointer(path))
+            finally:
+                pop_a.close()
+
+            pop_b = DistributedPopulation(OneMax, size=4, seed=0, port=port, job_timeout=60)
+            try:
+                ga_b = GeneticAlgorithm(pop_b, seed=0)
+                ga_b.run(3, checkpointer=Checkpointer(path))  # TOTAL, not 3 more
+                assert ga_b.generation == 3
+                assert len(ga_b.history) == 3
+                _assert_quiescent(ga_b.population.broker)
+            finally:
+                ga_b.population.close()
+                pop_b.close()
+        finally:
+            stop.set()

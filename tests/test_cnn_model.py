@@ -590,9 +590,10 @@ class TestBatchCompositionPurity:
         g1 = {"S_1": (1, 0, 1), "S_2": (0, 1, 1, 0, 0, 1)}
         g2 = {"S_1": (0, 1, 1), "S_2": (0, 1, 1, 0, 0, 1)}
         h = _genome_hashes([g1, g2, g1])
-        assert h[0] == h[2] != h[1]
+        assert h.shape == (3, 2) and h.dtype == np.uint32  # 64 bits as two words
+        assert tuple(h[0]) == tuple(h[2]) != tuple(h[1])
         # order of evaluation / position in the list is irrelevant
-        assert _genome_hashes([g2, g1])[1] == h[0]
+        assert tuple(_genome_hashes([g2, g1])[1]) == tuple(h[0])
 
     def test_key_stream_domains_are_separated(self):
         """Init, CV-train, and holdout streams must never collide for one
@@ -606,7 +607,9 @@ class TestBatchCompositionPurity:
             MaskedGeneticCnn, _content_keys, _genome_hashes, _init_population_params,
         )
 
-        assert cnn_mod._INIT_DOMAIN != cnn_mod._HOLDOUT_DOMAIN != 0
+        assert cnn_mod._INIT_DOMAIN and cnn_mod._HOLDOUT_DOMAIN and (
+            cnn_mod._INIT_DOMAIN != cnn_mod._HOLDOUT_DOMAIN
+        )
         base = jax.random.PRNGKey(0)
         h = _genome_hashes([{"S_1": (1, 0, 1)}])
         train = np.asarray(_content_keys(base, 1, h))  # CV train keys, fold 0
