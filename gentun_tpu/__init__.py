@@ -32,8 +32,10 @@ from .genes import (
 from .individuals import BoostingIndividual, GeneticCnnIndividual, Individual, XgboostIndividual
 from .populations import GridPopulation, Population
 from .algorithms import GeneticAlgorithm, RussianRouletteGA
+from . import telemetry  # noqa: F401  (zero-dependency; see docs/OBSERVABILITY.md)
 
 __all__ = [
+    "telemetry",
     "BinaryGene",
     "FloatGene",
     "IntGene",

@@ -26,6 +26,7 @@ import numpy as np
 
 from .individuals import Individual
 from .populations import Population
+from .telemetry import spans as _tele
 from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
 
 __all__ = ["GeneticAlgorithm", "RussianRouletteGA"]
@@ -98,43 +99,57 @@ class GeneticAlgorithm:
 
     def select_parent(self) -> Individual:
         """Tournament selection: sample t individuals, fittest wins."""
-        size = len(self.population)
-        t = min(self.tournament_size, size)
-        idx = self.rng.choice(size, size=t, replace=False)
-        contenders = [self.population[int(i)] for i in idx]
-        key = lambda ind: ind.get_fitness()
-        return max(contenders, key=key) if self.population.maximize else min(contenders, key=key)
+        with _tele.span("select"):
+            size = len(self.population)
+            t = min(self.tournament_size, size)
+            idx = self.rng.choice(size, size=t, replace=False)
+            contenders = [self.population[int(i)] for i in idx]
+            key = lambda ind: ind.get_fitness()
+            return max(contenders, key=key) if self.population.maximize else min(contenders, key=key)
 
     # -- evolution ---------------------------------------------------------
 
     def evolve_population(self) -> None:
-        """One generation step: evaluate → select → reproduce (SURVEY.md §3.1)."""
-        t0 = time.monotonic()
-        # Count only the individuals actually trained this step (cached elites,
-        # fitness-cache hits, and dedup'd duplicates don't inflate the metric):
-        # evaluate() reports exactly how many hit the compute path.
-        evaluated = self.population.evaluate() or 0
-        fittest = self.population.get_fittest()
-        elapsed = max(time.monotonic() - t0, 1e-9)
-        self._log_generation(fittest, evaluated, elapsed)
+        """One generation step: evaluate → select → reproduce (SURVEY.md §3.1).
 
-        next_individuals: List[Individual] = []
-        if self.elitism:
-            next_individuals.append(fittest.copy())  # keeps cached fitness
-        while len(next_individuals) < len(self.population):
-            mother = self.select_parent()
-            father = self.select_parent()
-            next_individuals.append(mother.reproduce(father, self.rng))
+        Telemetry: the whole step is a ``generation`` span; ``evaluate``,
+        ``select`` (inside :meth:`select_parent`), ``reproduce``, and
+        ``checkpoint`` nest under it.  The evaluate span is live while job
+        payloads are built, so its context is what rides the wire to
+        workers (``DistributedPopulation._evaluate_once``).
+        """
+        with _tele.span("generation", {"generation": self.generation}):
+            t0 = time.monotonic()
+            # Count only the individuals actually trained this step (cached
+            # elites, fitness-cache hits, and dedup'd duplicates don't inflate
+            # the metric): evaluate() reports exactly how many hit the
+            # compute path.
+            with _tele.span("evaluate"):
+                evaluated = self.population.evaluate() or 0
+                fittest = self.population.get_fittest()
+            elapsed = max(time.monotonic() - t0, 1e-9)
+            self._log_generation(fittest, evaluated, elapsed)
 
-        # clone_with keeps the population's concrete type across generations
-        # (a DistributedPopulation must carry its broker forward).
-        self.population = self.population.clone_with(next_individuals)
-        self.generation += 1
-        if self._checkpointer is not None:
-            self._checkpointer.save(self)
-        if self._fault_injector is not None:
-            # After the checkpoint: a kill here is the recoverable crash.
-            self._fault_injector.master_boundary(self.generation)
+            next_individuals: List[Individual] = []
+            if self.elitism:
+                next_individuals.append(fittest.copy())  # keeps cached fitness
+            with _tele.span("reproduce"):
+                while len(next_individuals) < len(self.population):
+                    mother = self.select_parent()
+                    father = self.select_parent()
+                    next_individuals.append(mother.reproduce(father, self.rng))
+
+            # clone_with keeps the population's concrete type across
+            # generations (a DistributedPopulation must carry its broker
+            # forward).
+            self.population = self.population.clone_with(next_individuals)
+            self.generation += 1
+            if self._checkpointer is not None:
+                with _tele.span("checkpoint"):
+                    self._checkpointer.save(self)
+            if self._fault_injector is not None:
+                # After the checkpoint: a kill here is the recoverable crash.
+                self._fault_injector.master_boundary(self.generation)
 
     def run(self, max_generations: int, checkpointer=None) -> Individual:
         """Run the search; returns the final fittest individual.
@@ -161,10 +176,16 @@ class GeneticAlgorithm:
             len(self.population),
             remaining,
         )
-        for _ in range(max(remaining, 0)):
-            self.evolve_population()
-        self.population.evaluate()
-        best = self.population.get_fittest()
+        # One root span per run → one trace_id stitching every generation
+        # (and, via payload propagation, every worker span) together.
+        with _tele.span("run", {"generations": max(remaining, 0)}):
+            for _ in range(max(remaining, 0)):
+                self.evolve_population()
+            # The final offspring still need fitness; give the pass its own
+            # evaluate span so its worker spans parent consistently.
+            with _tele.span("evaluate"):
+                self.population.evaluate()
+                best = self.population.get_fittest()
         logger.info("search done: best fitness %.6g, genes %s", best.get_fitness(), best.get_genes())
         return best
 
@@ -352,9 +373,10 @@ class RussianRouletteGA(GeneticAlgorithm):
         return weights
 
     def select_parent(self) -> Individual:
-        weights = self._selection_weights()
-        idx = int(self.rng.choice(len(self.population), p=weights))
-        return self.population[idx]
+        with _tele.span("select"):
+            weights = self._selection_weights()
+            idx = int(self.rng.choice(len(self.population), p=weights))
+            return self.population[idx]
 
     # selection_floor must ride checkpoints like its sibling hyperparams
     # (tournament_size, elitism): an exact-paper (None) study must not

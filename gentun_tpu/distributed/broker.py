@@ -41,6 +41,8 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
+from ..telemetry import spans as _tele
+from ..telemetry.registry import get_registry as _get_registry
 from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
 
 __all__ = ["JobBroker", "JobFailed", "GatherTimeout"]
@@ -161,6 +163,10 @@ class JobBroker:
         self._fail_counts: Dict[str, int] = {}
         self._workers: Dict[int, _Worker] = {}
         self._worker_seq = itertools.count()
+        # Telemetry (loop-thread only): monotonic (re)enqueue stamp per open
+        # job, feeding queue_wait and job spans.  Populated only while
+        # telemetry is enabled; pruned wherever _payloads is pruned.
+        self._tele_enqueued: Dict[str, float] = {}
 
         # Cross-thread results channel
         self._cond = threading.Condition()
@@ -268,9 +274,15 @@ class JobBroker:
             encode({"type": "jobs", "jobs": [{"job_id": job_id, **payload}]})
 
         def _enqueue():
+            tele = _tele.enabled()
+            now = time.monotonic()
             for job_id, payload in payloads.items():
                 self._payloads[job_id] = payload
                 self._pending.append(job_id)
+                if tele:
+                    self._tele_enqueued[job_id] = now
+            if tele:
+                _get_registry().gauge("broker_queue_depth").set(len(self._pending))
             self._dispatch()
 
         self._loop.call_soon_threadsafe(_enqueue)
@@ -385,6 +397,7 @@ class JobBroker:
         def _do():
             for j in ids:
                 self._payloads.pop(j, None)
+                self._tele_enqueued.pop(j, None)
             if any(j in ids for j in self._pending):
                 # Drain cancelled ids now: with no worker connected nothing
                 # else pops the deque, and a retry loop would grow it by one
@@ -472,6 +485,7 @@ class JobBroker:
         """
         if not self._pending:
             return
+        tele = _tele.enabled()
         for w in list(self._workers.values()):
             batch: List[Dict[str, Any]] = []
             batch_bytes = 0
@@ -486,6 +500,17 @@ class JobBroker:
                     continue
                 w.credit -= 1
                 w.in_flight.add(job_id)
+                if tele:
+                    # queue_wait: time from (re)enqueue to handoff.  The
+                    # stamp stays in place — _on_result uses it for the
+                    # end-to-end job span.
+                    t_enq = self._tele_enqueued.get(job_id)
+                    if t_enq is not None:
+                        _tele.record_span(
+                            "queue_wait", t_enq, time.monotonic() - t_enq,
+                            trace=self._payloads[job_id].get("trace"),
+                            attrs={"worker": w.worker_id},
+                        )
                 entry = {"job_id": job_id, **self._payloads[job_id]}
                 entry_bytes = len(encode(entry))
                 if batch and batch_bytes + entry_bytes > soft_cap:
@@ -497,6 +522,8 @@ class JobBroker:
                 self._send(w, {"type": "jobs", "jobs": batch})
             if not self._pending:
                 break
+        if tele:
+            _get_registry().gauge("broker_queue_depth").set(len(self._pending))
 
     def _send(self, w: _Worker, msg: Dict[str, Any]) -> None:
         try:
@@ -507,11 +534,16 @@ class JobBroker:
             logger.debug("write to worker %s failed", w.worker_id, exc_info=True)
 
     def _requeue_worker_jobs(self, w: _Worker, reason: str) -> None:
+        tele = _tele.enabled()
         for job_id in sorted(w.in_flight):
             if job_id in self._payloads:
                 logger.warning("requeue job %s (%s, worker %s)", job_id, reason, w.worker_id)
                 # Disconnect redelivery is unbounded, like AMQP's.
                 self._pending.append(job_id)
+                if tele:
+                    # Restart the clock: queue_wait/job measure time since
+                    # the LAST enqueue, not since first submission.
+                    self._tele_enqueued[job_id] = time.monotonic()
         w.in_flight.clear()
 
     async def _reaper(self) -> None:
@@ -569,6 +601,8 @@ class JobBroker:
                     worker.worker_id, worker.backend, sorted(others),
                 )
             self._workers[wid] = worker
+            if _tele.enabled():
+                _get_registry().gauge("broker_workers_connected").set(len(self._workers))
             writer.write(encode({"type": "welcome"}))
             logger.info(
                 "worker %s connected (capacity %d, %d chip(s))",
@@ -619,6 +653,8 @@ class JobBroker:
         finally:
             if worker is not None:
                 self._workers.pop(wid, None)
+                if _tele.enabled():
+                    _get_registry().gauge("broker_workers_connected").set(len(self._workers))
                 self._requeue_worker_jobs(worker, "disconnect")
                 self._dispatch()
             writer.close()
@@ -637,7 +673,22 @@ class JobBroker:
         if job_id not in self._payloads:
             logger.info("duplicate/stale result for %s dropped (redelivery race)", job_id)
             return
+        payload = self._payloads[job_id]
         del self._payloads[job_id]
+        if _tele.enabled():
+            # Behind the membership check on purpose: a duplicated result
+            # frame (chaos: duplicate_result) must not double-ingest the
+            # worker's span report either.
+            t_enq = self._tele_enqueued.pop(job_id, None)
+            if t_enq is not None:
+                dur = time.monotonic() - t_enq
+                _tele.record_span("job", t_enq, dur,
+                                  trace=payload.get("trace"),
+                                  attrs={"worker": w.worker_id})
+                _get_registry().histogram("broker_job_latency_seconds").observe(dur)
+            reported = msg.get("spans")
+            if reported:
+                _tele.ingest(reported)
         with self._cond:
             # Under _cond: reset_chips_seen()/chips_seen() run on the master
             # thread, and an unsynchronized read-modify-write here could
@@ -658,10 +709,13 @@ class JobBroker:
         if self._fail_counts[job_id] >= self._max_attempts:
             logger.error("job %s failed %d times: %s", job_id, self._fail_counts[job_id], reason)
             del self._payloads[job_id]
+            self._tele_enqueued.pop(job_id, None)
             with self._cond:
                 self._failures[job_id] = reason
                 self._cond.notify_all()
         else:
             logger.warning("job %s failed (%s); requeueing", job_id, reason)
             self._pending.append(job_id)
+            if _tele.enabled():
+                self._tele_enqueued[job_id] = time.monotonic()
             self._dispatch()

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Type
 
 from ..individuals import Individual
 from ..populations import Population
+from ..telemetry import spans as _tele
 from .protocol import MAX_MESSAGE_BYTES, AuthError, ProtocolError, decode, encode
 
 __all__ = ["GentunClient"]
@@ -471,7 +472,20 @@ class GentunClient:
                         1 for ind in individuals
                         if pop._safe_cache_key(ind) in self._store_keys
                     )
-                pop.evaluate()
+                captured: Optional[List[Dict[str, Any]]] = None
+                if _tele.enabled():
+                    # Adopt the master's trace context off the job payload,
+                    # collect every span this group produces (the `eval`
+                    # wrapper plus Population.evaluate's nested `train` and
+                    # any model-level compile/train/eval), and ship them
+                    # home in the first result frame of the group.
+                    with _tele.attach(ok_jobs[0].get("trace")), _tele.capture() as captured:
+                        with _tele.span("eval", {"jobs": len(individuals)}):
+                            pop.evaluate()
+                    for rec in captured:
+                        rec.setdefault("src", self.worker_id)
+                else:
+                    pop.evaluate()
                 if store_hits:
                     logger.info(
                         "fitness store answered %d/%d job(s) without training",
@@ -479,7 +493,13 @@ class GentunClient:
                     )
                 for job, ind in zip(ok_jobs, individuals):
                     if self._is_leader:
-                        self._send({"type": "result", "job_id": job["job_id"], "fitness": ind.get_fitness()})
+                        msg = {"type": "result", "job_id": job["job_id"], "fitness": ind.get_fitness()}
+                        if captured:
+                            # One report per group; capped well under the
+                            # frame limit (spans are ~200 bytes each).
+                            msg["spans"] = captured[:500]
+                            captured = None
+                        self._send(msg)
                         logger.info("job %s done: fitness %.6g", job["job_id"], ind.get_fitness())
                     self._jobs_done += 1
             except Exception as e:

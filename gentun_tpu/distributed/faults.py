@@ -58,6 +58,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..telemetry import spans as _tele
+from ..telemetry.registry import get_registry as _get_registry
 from .protocol import ProtocolError, encode
 
 __all__ = [
@@ -254,10 +256,20 @@ class FaultInjector:
                 if hit is None and s.at <= n < s.at + s.times:
                     hit = s
             if hit is not None:
-                self.fired.append({
+                record = {
                     "hook": hook, "kind": hit.kind, "type": mtype,
                     "worker": worker, "generation": generation,
-                })
+                }
+                self.fired.append(record)
+                if _tele.enabled():
+                    # Structured trail of every injected fault: a counter per
+                    # (hook, kind) in the registry plus an event record in the
+                    # run artifact (docs/OBSERVABILITY.md; the chaos artifact
+                    # asserts these — scripts/chaos_run.py).
+                    _get_registry().counter(
+                        "faults_injected_total", hook=hook, kind=hit.kind,
+                    ).inc()
+                    _tele.record_event("fault_injected", record)
             return hit
 
     # -- broker-side hooks (run on the broker loop thread) -----------------

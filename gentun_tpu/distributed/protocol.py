@@ -25,6 +25,21 @@ worker → broker       ``ping`` {}               liveness, from a side thread
 per-chip metric) and ``backend`` (fitness-model class name — the broker
 warns on a heterogeneous fleet).
 
+Telemetry fields (``gentun_tpu/telemetry``, docs/OBSERVABILITY.md) — both
+OPTIONAL and only present when tracing is enabled on the sending side;
+receivers that don't understand them ignore them, so mixed
+enabled/disabled fleets interoperate:
+
+- each ``jobs`` entry may carry ``trace`` {trace_id, span_id}: the
+  master-side span context under which the job was submitted.  The worker
+  re-attaches it so its spans join the master's trace.
+- the FIRST ``result`` frame of a worker's evaluation group may carry
+  ``spans`` [span records]: the group's captured worker-side spans
+  (eval/train/compile...), which the broker ingests into the active run
+  artifact.  It rides a result frame — not a separate message type — so
+  span reports inherit result-frame dedup: a duplicated frame cannot
+  double-ingest.
+
 Pings are deliberately UNANSWERED: the broker's ``last_seen`` update is
 the liveness mechanism, and replies the worker only reads between batches
 would pile up unread during a long training batch — a worker exiting

@@ -129,6 +129,10 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-plan", default=None, metavar="PATH",
                     help="chaos testing: JSON FaultPlan (distributed/faults.py) "
                          "injected into this worker's client hooks")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="collect spans for evaluated job groups and ship "
+                         "them to the master in result frames (equivalent to "
+                         "GENTUN_TPU_TELEMETRY=1; see docs/OBSERVABILITY.md)")
     mh = ap.add_argument_group(
         "multi-host",
         "run ONE logical worker across a multi-process jax cluster (e.g. all "
@@ -148,6 +152,10 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.telemetry:
+        from ..telemetry import spans as tele_spans
+
+        tele_spans.enable()
     if (args.num_processes is not None or args.process_id is not None) and args.coordinator is None:
         raise SystemExit("--num-processes/--process-id require --coordinator")
     multihost = args.coordinator is not None

@@ -51,6 +51,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import logging
+import time
 import weakref
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -64,6 +65,7 @@ import optax
 from ..ops.dag import stack_genome_masks
 from ..parallel.mesh import auto_mesh, pad_population, shard_cv_args
 from ..parallel.multihost import fetch, place, place_tree
+from ..telemetry import spans as _tele
 from ..utils.jax_state import mark_backend_used
 from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
 from .generic import GentunModel
@@ -370,6 +372,31 @@ def _segment_bounds(total_steps: int, segment_steps) -> List[Tuple[int, int]]:
     return [(s, min(s + seg, total_steps)) for s in range(0, total_steps, seg)]
 
 
+#: Program shapes already executed once in this process — how the telemetry
+#: split labels the FIRST call of a compiled shape `compile` and later calls
+#: `train`/`eval`.  Keys are (callable id, shape signature); the callables
+#: are lru-cached so ids are stable per static config.  "compile" honestly
+#: means compile + first execution (jax offers no portable way to time the
+#: compile alone without a throwaway AOT lower/compile cycle, which would
+#: change the disabled-path behavior this module guarantees).
+_tele_seen_programs: set = set()
+
+
+def _tele_device_span(kind_key, t0, result, attrs):
+    """End a telemetry span around one device call: sync on ``result``
+    (honest duration under jax async dispatch — ONLY reached when telemetry
+    is enabled), then record `compile` for a first-seen program shape and
+    the phase kind (`train`/`eval`) afterwards."""
+    jax.block_until_ready(result)
+    if kind_key in _tele_seen_programs:
+        kind = attrs.pop("_kind")
+    else:
+        _tele_seen_programs.add(kind_key)
+        attrs["phase"] = attrs.pop("_kind")
+        kind = "compile"
+    _tele.record_span(kind, t0, time.monotonic() - t0, attrs=attrs)
+
+
 def _run_segmented(
     cfg: Dict[str, Any],
     stacked,
@@ -418,6 +445,13 @@ def _run_segmented(
 
     kfold, total_steps = batch_idx.shape[0], batch_idx.shape[1]
     bounds = _segment_bounds(total_steps, cfg["segment_steps"])
+    # Telemetry (docs/OBSERVABILITY.md): per-call compile/train/eval spans
+    # need block_until_ready for honest durations — jax dispatch is async
+    # and every call below returns before the device finishes.  That sync
+    # costs pipelining, so it happens ONLY when telemetry is enabled; the
+    # disabled path is byte-identical to the uninstrumented executor.
+    tele = _tele.enabled()
+    pop_dim = int(next(iter(stacked[0].values())).shape[0]) if stacked else 0
     accs = []
     for f in range(kfold):
         p = jax.tree.map(lambda a: a[f], params)
@@ -431,7 +465,15 @@ def _run_segmented(
                 seg = place(batch_idx[f, s:e], batch_s)
             else:
                 seg = jnp.asarray(batch_idx[f, s:e])
-            p, opt, rng_f = train_pop(p, opt, masks, x_full, y_full, seg, rng_f)
+            if tele:
+                t0 = time.monotonic()
+                p, opt, rng_f = train_pop(p, opt, masks, x_full, y_full, seg, rng_f)
+                _tele_device_span(
+                    (id(train_pop), e - s, pop_dim, kfold), t0, (p, opt, rng_f),
+                    {"_kind": "train", "steps": e - s, "pop": pop_dim, "fold": f},
+                )
+            else:
+                p, opt, rng_f = train_pop(p, opt, masks, x_full, y_full, seg, rng_f)
         if mesh is not None:
             vi, vw = place(val_idx[f], repl), place(val_weight[f], repl)
         else:
@@ -441,7 +483,16 @@ def _run_segmented(
         # prepares fold f+1.  jax dispatch is async, so appending the device
         # array keeps the execution queue full across folds; params/opt
         # buffers still die at loop end (acc is tiny).
-        accs.append(eval_pop(p, masks, x_full, y_full, vi, vw))
+        if tele:
+            t0 = time.monotonic()
+            acc = eval_pop(p, masks, x_full, y_full, vi, vw)
+            _tele_device_span(
+                (id(eval_pop), pop_dim, kfold), t0, acc,
+                {"_kind": "eval", "pop": pop_dim, "fold": f},
+            )
+            accs.append(acc)
+        else:
+            accs.append(eval_pop(p, masks, x_full, y_full, vi, vw))
         del p, opt
     # fetch = np.asarray single-process; an all-gather of the pop-sharded
     # accuracies when the mesh spans processes (every host gets the full
@@ -1067,16 +1118,30 @@ class GeneticCnnModel(GentunModel):
             params, masks, fold_keys, arrays = shard_cv_args(
                 mesh, params, stacked, fold_keys, arrays
             )
-        acc = fn(
-            params,
-            masks,
-            arrays["x_full"],
-            arrays["y_full"],
-            arrays["val_idx"],
-            arrays["val_weight"],
-            arrays["batch_idx"],
-            fold_keys,
-        )
+        if _tele.enabled():
+            # Fused executor: train + eval are ONE program, so the split
+            # collapses to a single span (`compile` on the first shape).
+            t0 = time.monotonic()
+            acc = fn(
+                params, masks, arrays["x_full"], arrays["y_full"],
+                arrays["val_idx"], arrays["val_weight"], arrays["batch_idx"],
+                fold_keys,
+            )
+            _tele_device_span(
+                (id(fn), pop, kfold), t0, acc,
+                {"_kind": "train", "fused": True, "pop": pop, "kfold": kfold},
+            )
+        else:
+            acc = fn(
+                params,
+                masks,
+                arrays["x_full"],
+                arrays["y_full"],
+                arrays["val_idx"],
+                arrays["val_weight"],
+                arrays["batch_idx"],
+                fold_keys,
+            )
         return fetch(acc).astype(np.float32).mean(axis=0)[:n_real]
 
 

@@ -65,6 +65,44 @@ logger = logging.getLogger("gentun_tpu")
 _coordinator: Optional[str] = None
 
 
+def _enable_cpu_collectives() -> None:
+    """Give CPU-backend clusters a cross-process collectives implementation.
+
+    jaxlib's default CPU client has none: the first collective of a
+    multi-process CPU cluster raises ``Multiprocess computations aren't
+    implemented on the CPU backend``.  jax ≥ 0.4.3x ships gloo behind
+    ``jax_cpu_collectives_implementation``, which must be set BEFORE the
+    backend initializes — exactly where :func:`initialize` sits.  Only the
+    CPU platform is touched (TPU slices ride ICI and never take this
+    path), an explicit user setting wins, and an older jax without the
+    option is left alone (its CPU clusters simply can't collective — the
+    tests skip there).
+    """
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or str(getattr(jax.config, "jax_platforms", None) or "")).lower()
+    if "cpu" not in platforms:
+        return
+    try:
+        # The option has no attribute accessor in jax 0.4.3x; _read is the
+        # only way to see the current value ('none' = jaxlib's default).
+        current = jax.config._read("jax_cpu_collectives_implementation")
+    except Exception:
+        return
+    if current in (None, "", "none"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - gloo not compiled into jaxlib
+            return
+    # The XLA:CPU thunk runtime races gloo's TCP pairs on multi-collective
+    # programs (sharded CV aborts with "gloo::EnforceNotMet ...
+    # op.preamble.length <= op.nbytes"); the pre-thunk runtime runs them
+    # correctly.  Must land in XLA_FLAGS before the first backend init —
+    # which is why this hook lives at the top of :func:`initialize`.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_use_thunk_runtime" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_cpu_use_thunk_runtime=false").strip()
+
+
 def initialize(
     coordinator: str,
     num_processes: Optional[int] = None,
@@ -81,6 +119,7 @@ def initialize(
     required.
     """
     global _coordinator
+    _enable_cpu_collectives()
     kwargs: dict = {"coordinator_address": coordinator}
     if num_processes is not None:
         kwargs["num_processes"] = int(num_processes)
@@ -239,8 +278,12 @@ def broadcast_payload(obj: Any = None) -> Any:
     else:
         data = b""
     n = int(multihost_utils.broadcast_one_to_all(np.int64(len(data))))
-    buf = np.zeros(_bucket_bytes(n), dtype=np.uint8)
+    # int32 elements, one byte each: jaxlib's gloo CPU collectives mangle
+    # sub-word dtypes (a uint8 broadcast comes back with every byte widened
+    # to 4 — the backend strides the buffer as 32-bit words), and 4 bytes
+    # per payload byte is nothing next to job-payload sizes.
+    buf = np.zeros(_bucket_bytes(n), dtype=np.int32)
     if is_leader():
         buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
-    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf)).astype(np.uint8)
     return json.loads(bytes(out[:n]).decode("utf-8"))

@@ -24,6 +24,8 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Type
 import numpy as np
 
 from .individuals import Individual
+from .telemetry import spans as _tele
+from .telemetry.registry import get_registry as _get_registry
 
 __all__ = ["Population", "GridPopulation"]
 
@@ -168,11 +170,24 @@ class Population:
         4. **sequential fallback** — anything else takes the reference's
            lazy per-individual path (SURVEY.md §3.1).
         """
+        # Telemetry (docs/OBSERVABILITY.md): counters are incremented once
+        # per aggregate — never per individual — and only when enabled, so
+        # the disabled path does no extra work beyond one bool read.
+        tele = _tele.enabled()
         pending = [ind for ind in self.individuals if not ind.fitness_evaluated]
+        n_before = len(pending)
         pending = self._fill_from_cache(pending)
+        if tele and n_before > len(pending):
+            _get_registry().counter(
+                "population_cache_hits_total", species=self.species.__name__,
+            ).inc(n_before - len(pending))
         trained = 0
         for group in self._group_by_params(pending):
             reps = self._dedupe_group(group)
+            if tele and len(group) > len(reps):
+                _get_registry().counter(
+                    "population_dedup_collapsed_total", species=self.species.__name__,
+                ).inc(len(group) - len(reps))
             batch = reps
             spec: List[Individual] = []
             if self.speculative_fill and reps and self._batch_fn(reps) is not None:
@@ -193,17 +208,40 @@ class Population:
                     template=reps[0],
                 )
                 batch = reps + spec
-            if self._evaluate_batched(batch):
+                if tele and spec:
+                    _get_registry().counter(
+                        "population_speculative_total", species=self.species.__name__,
+                    ).inc(len(spec))
+            # The `train` span covers the group's actual compute — batched
+            # OR the sequential fallback — so every species (a worker-side
+            # OneMax as much as a vmapped CNN) reports training time.
+            # cnn.py's finer compile/train/eval spans nest inside this one.
+            if tele:
+                with _tele.span("train", {"individuals": len(batch),
+                                          "species": self.species.__name__}) as sp:
+                    batched_ok = self._train_group(batch, reps)
+                    sp.set(batched=batched_ok)
+            else:
+                batched_ok = self._train_group(batch, reps)
+            if batched_ok:
                 for ind in spec:
                     key = self._safe_cache_key(ind)
                     if key is not None:
                         self.fitness_cache[key] = ind.get_fitness()
-            else:
-                for ind in reps:  # sequential fallback: skip speculation
-                    ind.get_fitness()
             trained += len(reps)
             self._publish_group(group, reps)
         return trained
+
+    def _train_group(self, batch: List[Individual], reps: List[Individual]) -> bool:
+        """Train one parameter-group: batched if the species supports it,
+        else the reference's sequential per-individual path.  Returns
+        whether the batched path ran (speculative results only exist
+        then)."""
+        if self._evaluate_batched(batch):
+            return True
+        for ind in reps:  # sequential fallback: skip speculation
+            ind.get_fitness()
+        return False
 
     def _fill_target(self, n_real: int, params: Optional[Mapping[str, Any]] = None) -> int:
         """Batch size speculation fills to: the compile bucket (free mode,

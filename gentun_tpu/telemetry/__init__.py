@@ -1,0 +1,64 @@
+"""gentun_tpu observability plane: metrics registry, spans, run artifacts.
+
+Three small zero-dependency modules (see ``docs/OBSERVABILITY.md``):
+
+- :mod:`.registry` — process-local counters/gauges/log-bucket histograms
+  with Prometheus-text and JSONL renderers.
+- :mod:`.spans` — monotonic-clock spans with trace_id/parent_id context
+  that propagates across the distributed wire; no-op singleton fast path
+  when disabled (the default).
+- :mod:`.export` — ``RunTelemetry``: streams every span/event to a
+  per-run ``telemetry.jsonl`` and summarises exact p50/p95/p99 per span
+  kind plus counter totals, merged across worker reports.
+
+Quick start::
+
+    from gentun_tpu import telemetry
+    with telemetry.RunTelemetry("out/telemetry.jsonl"):
+        ga.run(generations)
+"""
+
+from .export import RunTelemetry, active_run, end_run, start_run
+from .registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from .spans import (
+    attach,
+    capture,
+    current_context,
+    disable,
+    enable,
+    enabled,
+    ingest,
+    record_event,
+    record_span,
+    span,
+)
+
+__all__ = [
+    "RunTelemetry",
+    "start_run",
+    "active_run",
+    "end_run",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "get_registry",
+    "DEFAULT_BUCKETS",
+    "span",
+    "record_span",
+    "record_event",
+    "enabled",
+    "enable",
+    "disable",
+    "current_context",
+    "attach",
+    "capture",
+    "ingest",
+]

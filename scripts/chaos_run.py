@@ -10,6 +10,12 @@ asserts the headline invariant: identical best-fitness history,
 evaluated-architecture set, and final population versus the fault-free
 run, with zero leaked broker state.
 
+The chaos search runs under the telemetry plane (``RunTelemetry``): every
+injected fault must surface as a ``fault_injected`` event in the
+telemetry artifact (asserted: the event kinds equal the kinds fired), and
+bit-identity against the telemetry-free clean run doubles as proof that
+telemetry never perturbs a search trajectory.
+
 CPU-only, a few seconds: `python scripts/chaos_run.py` writes
 ``scripts/chaos_run.json``.  The plan is serialized into the artifact, so
 a recorded run can be replayed exactly.
@@ -37,6 +43,7 @@ from gentun_tpu.distributed import (  # noqa: E402
     GentunClient,
     MasterKilled,
 )
+from gentun_tpu.telemetry import RunTelemetry  # noqa: E402
 from gentun_tpu.utils import Checkpointer  # noqa: E402
 
 GENERATIONS = 5
@@ -111,9 +118,16 @@ def run() -> dict:
     kill_inj = FaultInjector(master_plan)
 
     port = _free_port()
-    ckpt_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".chaos_ckpt.json")
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    ckpt_path = os.path.join(script_dir, ".chaos_ckpt.json")
     if os.path.exists(ckpt_path):
         os.unlink(ckpt_path)
+    # Telemetry wraps the WHOLE chaos story (both acts, both workers —
+    # in-process threads share the run sink); the clean reference above
+    # ran telemetry-free, so bit-identity below also proves the plane
+    # is trajectory-neutral.
+    tele_path = os.path.join(script_dir, ".chaos_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos").install()
     stops = [_worker(port, injector=w0_inj, worker_id="chaos-w0"),
              _worker(port, worker_id="clean-w1")]
 
@@ -151,6 +165,7 @@ def run() -> dict:
     finally:
         for s in stops:
             s.set()
+        tele_summary = run_tele.close()
         if os.path.exists(ckpt_path):
             os.unlink(ckpt_path)
 
@@ -160,6 +175,21 @@ def run() -> dict:
     assert identical, "chaos run diverged from the clean run"
     assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
     kinds_fired = sorted({f["kind"] for f in fired})
+
+    # -- every injected fault must surface in the telemetry artifact ------
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    fault_events = [r for r in tele_lines
+                    if r.get("type") == "event" and r.get("name") == "fault_injected"]
+    assert fault_events, "telemetry artifact recorded no fault events"
+    tele_event_kinds = sorted({e["data"]["kind"] for e in fault_events})
+    assert tele_event_kinds == kinds_fired, (
+        f"telemetry fault events {tele_event_kinds} != faults fired {kinds_fired}")
+    fault_counters = [c for c in tele_summary["counters"]
+                      if c["name"] == "faults_injected_total"]
+    assert sum(c["value"] for c in fault_counters) == len(fired)
+
     return {
         "generations": GENERATIONS,
         "population_size": POP_SIZE,
@@ -174,6 +204,12 @@ def run() -> dict:
         "best_fitness_history": chaos_snap["best_fitness_history"],
         "n_architectures_evaluated": chaos_snap["n_architectures_evaluated"],
         "chaos_wall_s": round(wall, 3),
+        "telemetry": {
+            "fault_events": len(fault_events),
+            "fault_event_kinds": tele_event_kinds,
+            "n_spans": tele_summary["n_spans"],
+            "span_kinds": sorted(tele_summary["spans"].keys()),
+        },
     }
 
 

