@@ -32,6 +32,7 @@ from .genes import (
 from .individuals import BoostingIndividual, GeneticCnnIndividual, Individual, XgboostIndividual
 from .populations import GridPopulation, Population
 from .algorithms import GeneticAlgorithm, RussianRouletteGA
+from .algorithms_async import AsyncEvolution
 from . import telemetry  # noqa: F401  (zero-dependency; see docs/OBSERVABILITY.md)
 
 __all__ = [
@@ -52,6 +53,7 @@ __all__ = [
     "GridPopulation",
     "GeneticAlgorithm",
     "RussianRouletteGA",
+    "AsyncEvolution",
 ]
 
 __version__ = "0.6.0"  # keep in sync with pyproject.toml

@@ -258,6 +258,12 @@ class GeneticAlgorithm:
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
+        algo = state.get("algorithm")
+        if algo == "AsyncEvolution":
+            raise ValueError(
+                "checkpoint was written by AsyncEvolution — steady-state "
+                "scheduler state (completion counters, in-flight children) "
+                "has no generational equivalent; resume it with AsyncEvolution")
         self.generation = int(state["generation"])
         self.tournament_size = int(state["tournament_size"])
         self.elitism = bool(state["elitism"])

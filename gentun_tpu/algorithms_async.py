@@ -1,0 +1,580 @@
+"""Asynchronous steady-state evolution: the generation barrier, removed.
+
+The generational loop (``algorithms.py``) evaluates a whole population,
+waits at a barrier, then breeds the next generation — so a fleet is only
+busy while a generation is wide, and the converged tail (1-4 fresh
+individuals per generation, PERF.md "Tail generations") pays a
+program-switch + dispatch + RPC floor per generation while most worker
+capacity idles.
+
+:class:`AsyncEvolution` replaces the barrier with *regularized evolution*
+(Real et al. 2019, "Regularized Evolution for Image Classifier Architecture
+Search") driven by a completion loop in the barrier-free worker style of
+population-based training (Jaderberg et al. 2017):
+
+- a bounded, age-ordered population (the *ring*): youngest appended,
+  oldest **evicted by age** — never by fitness — each time a child joins;
+- **aging tournament selection**: parents are the fittest of a uniform
+  sample of evaluated ring members;
+- a configurable number of evaluations (default: the fleet's total
+  capacity) stays in flight at all times — every completed evaluation
+  immediately breeds and dispatches a replacement child, so the fleet
+  stays busy through the tail.
+
+The engine is mode-agnostic: a data-holding :class:`Population` evaluates
+on a local thread pool; a ``DistributedPopulation`` uses the broker's
+completion-driven API (``wait_any``) with one coalesced submit per wake-up.
+Canonical-dedup and fitness-store reuse apply at dispatch: a child whose
+``cache_key`` is already measured completes instantly without occupying a
+worker slot, and a child identical to one already in flight attaches to it
+as a *follower* instead of training twice.
+
+Determinism: the engine consumes randomness only from its own generator,
+and every breeding decision is driven by the completion stream — with a
+deterministic completion order (one in-flight slot, or a single capacity-1
+worker) the whole trajectory is a pure function of the seed, checkpoints
+included.  The generational mode is untouched: ``GeneticAlgorithm`` remains
+the default and stays bit-identical.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue as _queue
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .individuals import Individual
+from .populations import Population
+from .telemetry import spans as _tele
+from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
+
+__all__ = ["AsyncEvolution"]
+
+logger = logging.getLogger("gentun_tpu")
+
+#: event tuple: (token, fitness-or-None, error-reason-or-None)
+_Event = Tuple[Any, Optional[float], Optional[str]]
+
+
+class _LocalEvaluator:
+    """Thread-pool evaluation for data-holding populations.
+
+    One worker thread per in-flight slot; completions land on a queue in
+    finish order.  With a single thread the executor is FIFO, which is the
+    deterministic configuration the seeded-determinism and kill/resume
+    tests rely on.
+    """
+
+    def __init__(self, n_threads: int):
+        self._n = max(1, int(n_threads))
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._n, thread_name_prefix="gentun-async-eval")
+        self._done: _queue.Queue = _queue.Queue()
+        self._seq = itertools.count()
+        self._futures: Dict[int, Any] = {}
+
+    def default_capacity(self) -> int:
+        return self._n
+
+    def submit(self, individuals: List[Individual]) -> List[int]:
+        tokens = []
+        for ind in individuals:
+            token = next(self._seq)
+            fut = self._pool.submit(ind.get_fitness)
+            fut.add_done_callback(lambda f, t=token: self._done.put((t, f)))
+            self._futures[token] = fut
+            tokens.append(token)
+        return tokens
+
+    def wait_any(self, timeout: Optional[float]) -> List[_Event]:
+        try:
+            token, fut = self._done.get(timeout=timeout)
+        except _queue.Empty:
+            return []
+        events = [self._event(token, fut)]
+        while True:  # drain whatever else already finished
+            try:
+                token, fut = self._done.get_nowait()
+            except _queue.Empty:
+                return events
+            events.append(self._event(token, fut))
+
+    def _event(self, token: int, fut) -> _Event:
+        self._futures.pop(token, None)
+        if fut.cancelled():
+            return (token, None, "cancelled")
+        exc = fut.exception()
+        if exc is not None:
+            return (token, None, repr(exc))
+        return (token, float(fut.result()), None)
+
+    def cancel(self, tokens) -> None:
+        for t in tokens:
+            fut = self._futures.pop(t, None)
+            if fut is not None:
+                fut.cancel()
+
+    def close(self) -> None:
+        try:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+        except TypeError:  # pragma: no cover - pre-3.9 fallback
+            self._pool.shutdown(wait=False)
+
+
+class _DistributedEvaluator:
+    """Completion-driven evaluation through a ``DistributedPopulation``.
+
+    Thin: payload construction and the broker's ``wait_any``/``cancel``
+    live on the population (``distributed/server.py``), keeping the wire
+    format single-owner.  Tokens are broker job ids.
+    """
+
+    def __init__(self, population):
+        self._pop = population
+        self._open: set = set()
+
+    def default_capacity(self) -> int:
+        # Wait briefly for the fleet so "capacity" means the real fleet,
+        # not the pre-connect instant — and keep watching after the first
+        # worker appears, because its peers are usually mid-handshake: a
+        # cap that stops growing for 0.75 s is taken as the fleet.
+        deadline = time.monotonic() + 10.0
+        cap, last_growth = 0, time.monotonic()
+        while time.monotonic() < deadline:
+            now = self._pop.fleet_capacity()
+            if now > cap:
+                cap, last_growth = now, time.monotonic()
+            elif cap > 0 and time.monotonic() - last_growth >= 0.75:
+                break
+            time.sleep(0.05)
+        return max(1, cap)
+
+    def submit(self, individuals: List[Individual]) -> List[str]:
+        ids = self._pop.submit_individuals(individuals)
+        self._open.update(ids)
+        return ids
+
+    def wait_any(self, timeout: Optional[float]) -> List[_Event]:
+        if not self._open:
+            return []
+        results, failures = self._pop.wait_any_results(list(self._open), timeout=timeout)
+        self._open -= set(results) | set(failures)
+        return ([(j, f, None) for j, f in results.items()]
+                + [(j, None, r) for j, r in failures.items()])
+
+    def cancel(self, tokens) -> None:
+        ids = [t for t in tokens if t in self._open]
+        self._open -= set(ids)
+        if ids:
+            self._pop.cancel_jobs(ids)
+
+    def close(self) -> None:
+        pass  # population/broker lifecycle belongs to the caller
+
+
+class AsyncEvolution:
+    """Steady-state aging-tournament evolution without a generation barrier.
+
+    Parameters
+    ----------
+    population:
+        The initial cohort — a :class:`Population` (local evaluation) or a
+        ``DistributedPopulation`` (broker-backed).  Its size is the ring's
+        bound for the whole search.
+    tournament_size:
+        Members sampled per parent draw; the fittest wins.
+    max_in_flight:
+        Evaluations kept in flight at all times.  ``None`` (default)
+        resolves at :meth:`run` to the connected fleet's total capacity
+        (distributed) or 1 (local).
+    seed:
+        Seeds the engine's own RNG; ``None`` shares the population's.
+    checkpoint_every:
+        Completions between checkpoint saves (and ``master_boundary``
+        fault hooks) when a checkpointer is attached.
+    job_timeout:
+        Max seconds to wait for ANY completion before raising — ``None``
+        waits forever (the generational default).
+    """
+
+    def __init__(
+        self,
+        population: Population,
+        tournament_size: int = 5,
+        max_in_flight: Optional[int] = None,
+        seed: Optional[int] = None,
+        checkpoint_every: int = 8,
+        job_timeout: Optional[float] = None,
+    ):
+        self.population = population
+        self.tournament_size = int(tournament_size)
+        self.max_in_flight = None if max_in_flight is None else max(1, int(max_in_flight))
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.job_timeout = job_timeout
+        self.rng = np.random.default_rng(seed) if seed is not None else population.rng
+        self.pop_size = len(population)
+        self.completed = 0
+        self.dispatched = 0
+        self.history: List[Dict[str, Any]] = []
+        #: copy of the best individual EVER completed — aging eviction may
+        #: remove the champion from the ring, so the ring's fittest member
+        #: is not the search's answer.
+        self.best: Optional[Individual] = None
+        self._checkpointer = None
+        self._fault_injector = None
+        self._last_ckpt = 0
+        # Scheduler state (also serialized): children bred and dispatched
+        # but not yet completed, in dispatch order — the piece a resumed
+        # run must re-dispatch to continue the same trajectory.
+        self._open_children: Dict[int, Individual] = {}
+        self._restored_in_flight: List[Individual] = []
+        # Run-local maps (rebuilt by run()).
+        self._queue: List[Tuple[Individual, bool]] = []
+        self._inflight: Dict[Any, Tuple[Individual, bool]] = {}
+        self._followers: Dict[Any, List[Tuple[Individual, bool]]] = {}
+        self._key_to_token: Dict[Any, Any] = {}
+        self._cap = 1
+
+    # -- hooks (same contract as GeneticAlgorithm) -------------------------
+
+    def set_checkpointer(self, checkpointer) -> None:
+        """Attach a completion-boundary checkpointer (``utils/checkpoint.py``)."""
+        self._checkpointer = checkpointer
+
+    def set_fault_injector(self, injector) -> None:
+        """Attach a chaos injector; ``master_boundary`` fires with the
+        completion count, AFTER each checkpoint save — a ``kill_master``
+        fault therefore lands exactly where resume is guaranteed from."""
+        self._fault_injector = injector
+
+    # -- selection ---------------------------------------------------------
+
+    def select_parent(self) -> Individual:
+        """Aging tournament over the ring's evaluated members."""
+        with _tele.span("select"):
+            members = [i for i in self.population if i.fitness_evaluated]
+            t = min(self.tournament_size, len(members))
+            idx = self.rng.choice(len(members), size=t, replace=False)
+            contenders = [members[int(i)] for i in idx]
+            key = lambda ind: ind.get_fitness()
+            return max(contenders, key=key) if self.population.maximize else min(contenders, key=key)
+
+    # -- the completion loop -----------------------------------------------
+
+    def run(self, max_evaluations: int, checkpointer=None) -> Individual:
+        """Run until ``max_evaluations`` evaluations completed (TOTAL, like
+        the generational ``run`` under a checkpointer: the initial cohort
+        counts, cache-answered children count, permanently failed
+        evaluations count — the budget is completions, so the loop always
+        terminates).  Returns a copy of the best individual ever measured.
+
+        With ``checkpointer``, the run is crash-resumable: any existing
+        checkpoint is restored first (ring, RNG state, history, best, and
+        the children that were in flight), and a killed master re-run with
+        the same arguments continues the search — deterministically, when
+        the completion order is deterministic (see the module docstring).
+        """
+        if checkpointer is not None:
+            self.set_checkpointer(checkpointer)
+            if checkpointer.resume(self):
+                logger.info("resumed async search at %d completion(s)", self.completed)
+        budget = int(max_evaluations)
+        evaluator = self._make_evaluator()
+        cap = self.max_in_flight
+        if cap is None:
+            cap = evaluator.default_capacity()
+        self._cap = max(1, int(cap))
+        self._last_ckpt = self.completed
+        # Everything whose evaluation is owed but not running: unevaluated
+        # ring members first (initial cohort / in-flight-at-kill members),
+        # then checkpointed in-flight children in dispatch order.
+        self._queue = [(ind, True) for ind in self.population if not ind.fitness_evaluated]
+        self._queue += [(ind, False) for ind in self._restored_in_flight]
+        self._restored_in_flight = []
+        self._inflight = {}
+        self._followers = {}
+        self._key_to_token = {}
+        self._open_children = {}
+        # Re-dispatch re-counts the queued work (members and restored
+        # children alike), so the budget gate stays consistent on resume.
+        self.dispatched = self.completed
+        logger.info(
+            "starting AsyncEvolution: ring=%d, budget=%d (%d done), in-flight target=%d",
+            self.pop_size, budget, self.completed, self._cap,
+        )
+        with _tele.span("run", {"mode": "async", "budget": budget,
+                                "max_in_flight": self._cap}):
+            try:
+                self._refill(evaluator, budget)
+                while self.completed < budget and (self._inflight or self._queue):
+                    events = evaluator.wait_any(self.job_timeout)
+                    if not events:
+                        raise TimeoutError(
+                            f"no evaluation completed within {self.job_timeout}s "
+                            f"({len(self._inflight)} in flight, "
+                            f"{self.completed}/{budget} done)")
+                    for token, fitness, error in events:
+                        self._on_event(token, fitness, error)
+                    self._refill(evaluator, budget)
+                    self._boundary()
+            finally:
+                leftover = list(self._inflight)
+                if leftover:
+                    # Budget reached with children still training: their
+                    # results are unwanted — withdraw instead of waiting.
+                    evaluator.cancel(leftover)
+                    for token in leftover:
+                        ind, _ = self._inflight.pop(token)
+                        self._open_children.pop(id(ind), None)
+                    self._key_to_token = {}
+                    self._followers = {}
+                evaluator.close()
+        if self.best is None:
+            raise RuntimeError("no evaluation ever completed successfully")
+        logger.info(
+            "async search done: %d completion(s), best fitness %.6g, genes %s",
+            self.completed, self.best.get_fitness(), self.best.get_genes(),
+        )
+        return self.best
+
+    # -- internals ---------------------------------------------------------
+
+    def _make_evaluator(self):
+        if hasattr(self.population, "broker"):
+            return _DistributedEvaluator(self.population)
+        return _LocalEvaluator(self.max_in_flight or 1)
+
+    def _can_breed(self) -> bool:
+        return any(i.fitness_evaluated for i in self.population)
+
+    def _breed(self) -> Individual:
+        with _tele.span("reproduce"):
+            mother = self.select_parent()
+            father = self.select_parent()
+            return mother.reproduce(father, self.rng)
+
+    def _refill(self, evaluator, budget: int) -> None:
+        """Top the in-flight set back up to the target, breeding as needed.
+
+        Children bred in one wake-up ship as ONE submit (one coalesced
+        ``jobs`` frame per worker window downstream).  Dispatch-side dedup:
+        a child already in the fitness cache (this search or a loaded
+        fitness store) completes instantly; a child identical to an
+        in-flight job becomes its follower.  Neither occupies a slot, so
+        the loop keeps breeding until real work fills the capacity or the
+        budget is spent.
+        """
+        to_submit: List[Tuple[Individual, bool, Any]] = []
+        while (self.dispatched < budget
+               and len(self._inflight) + len(to_submit) < self._cap):
+            if self._queue:
+                ind, is_member = self._queue.pop(0)
+            elif self._can_breed():
+                ind, is_member = self._breed(), False
+            else:
+                break  # nothing evaluated yet: wait for the cohort
+            self.dispatched += 1
+            key = self.population._safe_cache_key(ind)
+            cached = self.population.fitness_cache.get(key) if key is not None else None
+            if cached is not None:
+                self._complete(ind, float(cached), is_member, cached=True)
+                continue
+            token = self._key_to_token.get(key) if key is not None else None
+            if token is not None:
+                self._followers.setdefault(token, []).append((ind, is_member))
+                if not is_member:
+                    self._open_children[id(ind)] = ind
+                continue
+            to_submit.append((ind, is_member, key))
+        if to_submit:
+            tokens = evaluator.submit([ind for ind, _, _ in to_submit])
+            for token, (ind, is_member, key) in zip(tokens, to_submit):
+                self._inflight[token] = (ind, is_member)
+                if key is not None:
+                    self._key_to_token[key] = token
+                if not is_member:
+                    self._open_children[id(ind)] = ind
+
+    def _on_event(self, token, fitness: Optional[float], error: Optional[str]) -> None:
+        entry = self._inflight.pop(token, None)
+        if entry is None:
+            return  # cancelled/stale
+        ind, is_member = entry
+        key = self.population._safe_cache_key(ind)
+        if key is not None and self._key_to_token.get(key) is token:
+            del self._key_to_token[key]
+        followers = self._followers.pop(token, [])
+        if error is not None:
+            self._fail(ind, is_member, error)
+            for f_ind, f_member in followers:
+                self._fail(f_ind, f_member, error)
+            return
+        self._complete(ind, fitness, is_member)
+        for f_ind, f_member in followers:
+            self._complete(f_ind, fitness, f_member)
+
+    def _complete(self, ind: Individual, fitness: float, is_member: bool,
+                  cached: bool = False) -> None:
+        """One evaluation finished: membership, cache, best, history."""
+        if not ind.fitness_evaluated:
+            ind.set_fitness(fitness)
+        key = self.population._safe_cache_key(ind)
+        if key is not None and not cached:
+            self.population.fitness_cache[key] = float(fitness)
+        self._open_children.pop(id(ind), None)
+        if not is_member:
+            # Steady-state transition: child in (youngest), oldest out.
+            self.population.insert(ind)
+            if len(self.population) > self.pop_size:
+                self.population.evict_oldest()
+        if self.best is None:
+            better = True
+        elif self.population.maximize:
+            better = fitness > self.best.get_fitness()
+        else:
+            better = fitness < self.best.get_fitness()
+        if better:
+            self.best = ind.copy()  # keeps the fitness
+        self.completed += 1
+        self.history.append({
+            "completed": self.completed,
+            "fitness": float(fitness),
+            "best_fitness": self.best.get_fitness(),
+            "in_flight": len(self._inflight),
+            "cached": bool(cached),
+        })
+
+    def _fail(self, ind: Individual, is_member: bool, reason: str) -> None:
+        """A permanently failed evaluation consumes budget and breeds a
+        replacement (via the next refill) but never joins the ring — and a
+        failed MEMBER leaves it, so aging eviction never has to step over a
+        corpse."""
+        logger.warning("async evaluation failed permanently: %s", reason)
+        self._open_children.pop(id(ind), None)
+        if is_member:
+            try:
+                self.population.individuals.remove(ind)
+            except ValueError:  # pragma: no cover - defensive
+                pass
+        self.completed += 1
+        self.history.append({
+            "completed": self.completed,
+            "fitness": None,
+            "best_fitness": None if self.best is None else self.best.get_fitness(),
+            "in_flight": len(self._inflight),
+            "failed": True,
+        })
+
+    def _boundary(self) -> None:
+        """Checkpoint (and fire the chaos boundary hook) every
+        ``checkpoint_every`` completions — the async analogue of the
+        generation boundary."""
+        if self.completed - self._last_ckpt < self.checkpoint_every:
+            return
+        self._last_ckpt = self.completed
+        if self._checkpointer is not None:
+            with _tele.span("checkpoint"):
+                self._checkpointer.save(self)
+        if self._fault_injector is not None:
+            # After the checkpoint: a kill here is the recoverable crash.
+            self._fault_injector.master_boundary(self.completed)
+
+    # -- (de)serialization state for checkpoint/resume ---------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        fitness_cache = [
+            [k, v]
+            for k, v in self.population.fitness_cache.items()
+            if is_serializable_key(k)
+        ]
+        open_children = [ind.get_genes() for ind in self._open_children.values()]
+        return {
+            "algorithm": "AsyncEvolution",
+            "fitness_protocol": FITNESS_PROTOCOL,
+            "fitness_cache": fitness_cache,
+            "completed": self.completed,
+            "dispatched": self.completed + len(open_children),
+            "tournament_size": self.tournament_size,
+            "max_in_flight": self.max_in_flight,
+            "checkpoint_every": self.checkpoint_every,
+            "rng_state": self.rng.bit_generator.state,
+            "history": self.history,
+            "best": None if self.best is None else {
+                "genes": self.best.get_genes(),
+                "fitness": self.best.get_fitness(),
+            },
+            "population": {
+                "size": self.pop_size,
+                "maximize": self.population.maximize,
+                "crossover_rate": self.population.crossover_rate,
+                "mutation_rate": self.population.mutation_rate,
+                "additional_parameters": self.population.additional_parameters,
+                "individuals": [
+                    {"genes": ind.get_genes(), "fitness": ind._fitness}
+                    for ind in self.population
+                ],
+            },
+            # Children bred-but-uncompleted, in dispatch order: a resumed
+            # run re-dispatches exactly these (the breeding RNG draws that
+            # produced them are already consumed in rng_state).
+            "in_flight": open_children,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        algo = state.get("algorithm")
+        if algo not in (None, "AsyncEvolution"):
+            raise ValueError(
+                f"checkpoint was written by {algo}, not AsyncEvolution — "
+                "generational and steady-state scheduler state are not "
+                "interchangeable; resume it with the matching class")
+        self.completed = int(state["completed"])
+        self.tournament_size = int(state["tournament_size"])
+        if state.get("max_in_flight") is not None:
+            self.max_in_flight = int(state["max_in_flight"])
+        self.checkpoint_every = int(state.get("checkpoint_every", self.checkpoint_every))
+        self.rng.bit_generator.state = state["rng_state"]
+        self.history = list(state["history"])
+        pop_state = state["population"]
+        self.pop_size = int(pop_state.get("size", len(pop_state["individuals"])))
+        self.population.maximize = bool(pop_state["maximize"])
+        self.population.crossover_rate = float(pop_state["crossover_rate"])
+        self.population.mutation_rate = float(pop_state["mutation_rate"])
+        self.population.additional_parameters = dict(pop_state["additional_parameters"])
+        # Same cross-protocol guard as the generational loader: fitnesses
+        # measured under an older fitness-RNG protocol are incomparable —
+        # drop them (loudly) and let the ring re-measure.
+        proto = state.get("fitness_protocol", 1)
+        proto_ok = proto == FITNESS_PROTOCOL
+        if not proto_ok:
+            logger.warning(
+                "checkpoint was written under fitness RNG protocol %s "
+                "(current: %s); discarding its fitness values and cache — "
+                "the resumed search re-measures instead of mixing "
+                "incomparable measurements", proto, FITNESS_PROTOCOL,
+            )
+        individuals = []
+        for ind_state in pop_state["individuals"]:
+            ind = self.population.spawn(genes=ind_state["genes"])
+            if ind_state["fitness"] is not None and proto_ok:
+                ind.set_fitness(ind_state["fitness"])
+            individuals.append(ind)
+        self.population.individuals = individuals
+        self.population.fitness_cache = {
+            tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
+        } if proto_ok else {}
+        best = state.get("best")
+        if best is not None and proto_ok:
+            b = self.population.spawn(genes=best["genes"])
+            b.set_fitness(best["fitness"])
+            self.best = b
+        else:
+            self.best = None
+        self._restored_in_flight = [
+            self.population.spawn(genes=g) for g in state.get("in_flight", [])
+        ]
+        self._last_ckpt = self.completed

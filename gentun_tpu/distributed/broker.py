@@ -13,7 +13,12 @@ reference got for free from AMQP:
 - **redelivery without double-count** — the first ``result`` per job wins;
   late duplicates from a worker that "died" but finished anyway are dropped;
 - **per-generation barrier** — :meth:`gather` blocks until every submitted
-  job has a result (stragglers gate the generation, SURVEY.md §3.2).
+  job has a result (stragglers gate the generation, SURVEY.md §3.2);
+- **completion-driven consumption** — :meth:`wait_any` blocks only until
+  *some* submitted job reaches a terminal state, which is what the
+  asynchronous steady-state engine (``algorithms_async.AsyncEvolution``)
+  uses instead of the barrier: a returning result immediately breeds and
+  dispatches a replacement, keeping the fleet busy through the tail.
 
 Architecture: a single asyncio event loop in a daemon thread owns ALL broker
 state (no locks on the hot path); the master thread talks to it through
@@ -282,10 +287,46 @@ class JobBroker:
                 if tele:
                     self._tele_enqueued[job_id] = now
             if tele:
-                _get_registry().gauge("broker_queue_depth").set(len(self._pending))
+                self._update_flow_gauges()
             self._dispatch()
 
         self._loop.call_soon_threadsafe(_enqueue)
+
+    def wait_any(
+        self, job_ids: List[str], timeout: Optional[float] = None
+    ) -> tuple[Dict[str, float], Dict[str, str]]:
+        """Block until at least ONE of ``job_ids`` is terminal; no barrier.
+
+        Returns ``(results, failures)`` — every fitness and permanent
+        failure available at wake-up (so a burst of completions drains in
+        one call), pruned from broker state exactly like :meth:`gather`'s.
+        Both dicts empty ⇔ the timeout expired with nothing terminal.
+        The caller owns retry/penalty policy; unlike :meth:`gather` this
+        never raises, because the steady-state engine treats a failure as
+        one completed (dead) evaluation, not a reason to stop the world.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        want = set(job_ids)
+        with self._cond:
+            while True:
+                done_r = {j: self._results[j] for j in want if j in self._results}
+                done_f = {j: self._failures[j] for j in want if j in self._failures}
+                if done_r or done_f:
+                    self._prune_gathered(set(done_r) | set(done_f))
+                    return done_r, done_f
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return {}, {}
+                self._cond.wait(timeout=min(remaining, 1.0) if remaining is not None else 1.0)
+
+    def cancel(self, job_ids) -> None:
+        """Withdraw still-open jobs (the public face of :meth:`_cancel_jobs`).
+
+        The steady-state engine calls this for children still in flight
+        when its evaluation budget is reached: their results are no longer
+        wanted, and a late arrival is dropped as stale.
+        """
+        self._cancel_jobs(set(job_ids))
 
     def gather(self, job_ids: List[str], timeout: Optional[float] = None) -> Dict[str, float]:
         """Block until every job in ``job_ids`` has a fitness (the barrier).
@@ -419,6 +460,8 @@ class JobBroker:
                     self._results.pop(j, None)
                     self._failures.pop(j, None)
                     self._fail_counts.pop(j, None)
+            if _tele.enabled():
+                self._update_flow_gauges()
 
         self._loop.call_soon_threadsafe(_do)
 
@@ -426,6 +469,15 @@ class JobBroker:
         """submit + gather in one call."""
         self.submit(payloads)
         return self.gather(list(payloads), timeout=timeout)
+
+    def fleet_capacity(self) -> int:
+        """Total job slots advertised by the connected workers (0 when none).
+
+        The asynchronous engine's default in-flight target: capacity-C
+        fleet ⇒ keep C evaluations in flight.  Snapshot read — safe from
+        any thread.
+        """
+        return sum(w.capacity for w in list(self._workers.values()))
 
     def fleet_chips(self) -> int:
         """Total accelerator chips advertised by the connected workers (≥1).
@@ -475,6 +527,22 @@ class JobBroker:
 
     # -- loop-thread internals --------------------------------------------
 
+    def _update_flow_gauges(self) -> None:
+        """Refresh the tail-regime flow gauges (loop thread, telemetry on).
+
+        ``jobs_in_flight`` (jobs handed to workers, unacked) is the gauge
+        the async-mode acceptance test samples: a capacity-C fleet under
+        the steady-state engine must sustain it at ≥ C.  ``queue_depth``
+        is the undispatched backlog; ``broker_queue_depth`` is kept as an
+        alias for pre-existing dashboards.
+        """
+        reg = _get_registry()
+        reg.gauge("jobs_in_flight").set(
+            sum(len(w.in_flight) for w in self._workers.values()))
+        depth = len(self._pending)
+        reg.gauge("queue_depth").set(depth)
+        reg.gauge("broker_queue_depth").set(depth)
+
     def _dispatch(self) -> None:
         """Hand pending jobs to workers with spare credit (competing consumers).
 
@@ -506,11 +574,16 @@ class JobBroker:
                     # end-to-end job span.
                     t_enq = self._tele_enqueued.get(job_id)
                     if t_enq is not None:
+                        wait = time.monotonic() - t_enq
                         _tele.record_span(
-                            "queue_wait", t_enq, time.monotonic() - t_enq,
+                            "queue_wait", t_enq, wait,
                             trace=self._payloads[job_id].get("trace"),
                             attrs={"worker": w.worker_id},
                         )
+                        # The registry twin of the span: a per-job wait
+                        # histogram dashboards can read without span
+                        # post-processing (tail-regime pressure signal).
+                        _get_registry().histogram("queue_wait_s").observe(wait)
                 entry = {"job_id": job_id, **self._payloads[job_id]}
                 entry_bytes = len(encode(entry))
                 if batch and batch_bytes + entry_bytes > soft_cap:
@@ -523,7 +596,7 @@ class JobBroker:
             if not self._pending:
                 break
         if tele:
-            _get_registry().gauge("broker_queue_depth").set(len(self._pending))
+            self._update_flow_gauges()
 
     def _send(self, w: _Worker, msg: Dict[str, Any]) -> None:
         try:
@@ -545,6 +618,8 @@ class JobBroker:
                     # the LAST enqueue, not since first submission.
                     self._tele_enqueued[job_id] = time.monotonic()
         w.in_flight.clear()
+        if tele:
+            self._update_flow_gauges()
 
     async def _reaper(self) -> None:
         """Declare silent workers holding jobs dead; requeue their jobs."""
@@ -642,6 +717,20 @@ class JobBroker:
                     self._dispatch()
                 elif mtype == "result":
                     self._on_result(worker, msg)
+                elif mtype == "results":
+                    # Coalesced form: one frame per worker evaluation group
+                    # instead of one per job (protocol.py).  Each entry is
+                    # deduplicated independently; the group's span report
+                    # rides the frame and is ingested with the FIRST entry
+                    # that survives dedup, so a duplicated frame still
+                    # cannot double-ingest.
+                    spans = msg.get("spans")
+                    for entry in msg.get("results", ()):
+                        e = dict(entry)
+                        if spans is not None:
+                            e["spans"] = spans
+                        if self._on_result(worker, e):
+                            spans = None
                 elif mtype == "fail":
                     self._on_fail(worker, msg)
                 else:
@@ -659,7 +748,8 @@ class JobBroker:
                 self._dispatch()
             writer.close()
 
-    def _on_result(self, w: _Worker, msg: Dict[str, Any]) -> None:
+    def _on_result(self, w: _Worker, msg: Dict[str, Any]) -> bool:
+        """Record one result; True iff it was fresh (not a stale duplicate)."""
         job_id = str(msg["job_id"])
         # Parse BEFORE touching broker state: a malformed fitness must count
         # as a worker-side failure (redeliverable), not delete the payload
@@ -668,11 +758,11 @@ class JobBroker:
             fitness = float(msg["fitness"])
         except (KeyError, TypeError, ValueError):
             self._on_fail(w, {"job_id": job_id, "reason": f"malformed fitness: {msg.get('fitness')!r}"})
-            return
+            return False
         w.in_flight.discard(job_id)
         if job_id not in self._payloads:
             logger.info("duplicate/stale result for %s dropped (redelivery race)", job_id)
-            return
+            return False
         payload = self._payloads[job_id]
         del self._payloads[job_id]
         if _tele.enabled():
@@ -689,6 +779,7 @@ class JobBroker:
             reported = msg.get("spans")
             if reported:
                 _tele.ingest(reported)
+            self._update_flow_gauges()
         with self._cond:
             # Under _cond: reset_chips_seen()/chips_seen() run on the master
             # thread, and an unsynchronized read-modify-write here could
@@ -696,6 +787,7 @@ class JobBroker:
             self._chips_seen = max(self._chips_seen, self.fleet_chips())
             self._results[job_id] = fitness
             self._cond.notify_all()
+        return True
 
     def _on_fail(self, w: _Worker, msg: Dict[str, Any]) -> None:
         job_id = str(msg["job_id"])
@@ -710,6 +802,8 @@ class JobBroker:
             logger.error("job %s failed %d times: %s", job_id, self._fail_counts[job_id], reason)
             del self._payloads[job_id]
             self._tele_enqueued.pop(job_id, None)
+            if _tele.enabled():
+                self._update_flow_gauges()
             with self._cond:
                 self._failures[job_id] = reason
                 self._cond.notify_all()

@@ -32,7 +32,14 @@ from typing import Any, Dict, List, Optional, Type
 from ..individuals import Individual
 from ..populations import Population
 from ..telemetry import spans as _tele
-from .protocol import MAX_MESSAGE_BYTES, AuthError, ProtocolError, decode, encode
+from .protocol import (
+    MAX_MESSAGE_BYTES,
+    AuthError,
+    ProtocolError,
+    coalesce_results,
+    decode,
+    encode,
+)
 
 __all__ = ["GentunClient"]
 
@@ -491,17 +498,21 @@ class GentunClient:
                         "fitness store answered %d/%d job(s) without training",
                         store_hits, len(individuals),
                     )
+                entries = []
                 for job, ind in zip(ok_jobs, individuals):
-                    if self._is_leader:
-                        msg = {"type": "result", "job_id": job["job_id"], "fitness": ind.get_fitness()}
-                        if captured:
-                            # One report per group; capped well under the
-                            # frame limit (spans are ~200 bytes each).
-                            msg["spans"] = captured[:500]
-                            captured = None
-                        self._send(msg)
-                        logger.info("job %s done: fitness %.6g", job["job_id"], ind.get_fitness())
+                    entries.append({"job_id": job["job_id"], "fitness": ind.get_fitness()})
                     self._jobs_done += 1
+                if self._is_leader and entries:
+                    # The whole capacity window acks as ONE `results` frame
+                    # (protocol.coalesce_results) instead of a TCP frame per
+                    # job — the worker-side half of the batched-dispatch
+                    # contract, and the lever on the tail-regime RPC floor.
+                    # The group's span report (capped well under the frame
+                    # limit; spans are ~200 bytes each) rides the first frame.
+                    for msg in coalesce_results(entries, spans=captured[:500] if captured else None):
+                        self._send(msg)
+                    for entry in entries:
+                        logger.info("job %s done: fitness %.6g", entry["job_id"], entry["fitness"])
             except Exception as e:
                 # Evaluation is all-or-nothing per group: report every job so
                 # the broker can redeliver (ack-after-work semantics).

@@ -16,6 +16,7 @@ broker → worker       ``welcome`` {} | ``error`` {reason}
 worker → broker       ``ready`` {credit}        request up to `credit` jobs
 broker → worker       ``jobs`` {jobs: [{job_id, genes, additional_parameters}, ...]}
 worker → broker       ``result`` {job_id, fitness}   = the ack (ack-after-work)
+worker → broker       ``results`` {results: [{job_id, fitness}, ...]}  coalesced acks
 worker → broker       ``fail`` {job_id, reason}      evaluation raised
 worker → broker       ``ping`` {}               liveness, from a side thread
 ====================  =====================================================
@@ -61,14 +62,32 @@ batch whose encoded size would approach ``MAX_MESSAGE_BYTES`` is split at a
 soft size cap into several consecutive ``jobs`` frames, which the worker
 consumes (and trains) one frame at a time — batching degrades gracefully
 for pathologically large payloads instead of breaking the protocol.
+
+Results travel the same way: a worker's evaluation group replies with ONE
+``results`` frame per capacity window (``coalesce_results``) instead of a
+TCP frame per job, so a capacity-8 batch is 1 syscall + 1 broker wake-up
+instead of 8 — this shaves the measured small-batch RPC floor of the
+converged tail (PERF.md "Tail generations") in both the generational and
+the asynchronous mode.  Each entry inside the frame is deduplicated
+independently on the broker (at-least-once semantics are unchanged), the
+group's span report rides the frame exactly as it used to ride the first
+``result`` frame, and the single-job ``result`` frame remains accepted for
+back-compat with older workers.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional
 
-__all__ = ["encode", "decode", "MAX_MESSAGE_BYTES", "ProtocolError", "AuthError"]
+__all__ = [
+    "encode",
+    "decode",
+    "coalesce_results",
+    "MAX_MESSAGE_BYTES",
+    "ProtocolError",
+    "AuthError",
+]
 
 #: Hard cap per message; genes + params are a few KB, so anything huge is a
 #: protocol violation (or an attempt to ship training data, which the design
@@ -114,3 +133,41 @@ def decode(line: bytes) -> Dict[str, Any]:
     if not isinstance(msg, dict) or "type" not in msg:
         raise ProtocolError(f"frame is not a typed message: {msg!r}")
     return msg
+
+
+def coalesce_results(
+    entries: List[Dict[str, Any]],
+    spans: Optional[List[Dict[str, Any]]] = None,
+    soft_cap: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Pack per-job result entries into the fewest ``results`` frames.
+
+    The worker-side mirror of the broker's ``jobs`` batching: one frame per
+    capacity window, split at a soft size cap (default
+    ``MAX_MESSAGE_BYTES // 2``) so a pathological batch degrades into
+    several valid frames instead of one oversized one.  ``spans`` (the
+    group's captured telemetry report) is attached to the FIRST frame only,
+    preserving the ride-the-first-result dedup contract.  Returns message
+    dicts, not bytes — the client's send path owns encoding (and fault
+    injection sees typed messages).
+    """
+    cap = int(soft_cap) if soft_cap else MAX_MESSAGE_BYTES // 2
+    batches: List[List[Dict[str, Any]]] = []
+    batch: List[Dict[str, Any]] = []
+    batch_bytes = 0
+    for entry in entries:
+        entry_bytes = len(json.dumps(entry, separators=(",", ":")).encode("utf-8"))
+        if batch and batch_bytes + entry_bytes > cap:
+            batches.append(batch)
+            batch, batch_bytes = [], 0
+        batch.append(entry)
+        batch_bytes += entry_bytes
+    if batch:
+        batches.append(batch)
+    frames: List[Dict[str, Any]] = []
+    for i, group in enumerate(batches):
+        msg: Dict[str, Any] = {"type": "results", "results": group}
+        if i == 0 and spans:
+            msg["spans"] = spans
+        frames.append(msg)
+    return frames

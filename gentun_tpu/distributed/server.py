@@ -174,6 +174,50 @@ class DistributedPopulation(Population):
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- asynchronous (steady-state) evaluation API ------------------------
+    #
+    # Used by ``algorithms_async.AsyncEvolution`` instead of the barrier:
+    # ship → wait for ANY completion → breed a replacement → ship again.
+    # Payload construction (genes + additional_parameters + trace) lives
+    # here so the wire format has exactly one owner for both modes.
+
+    def fleet_capacity(self) -> int:
+        """Total job slots the connected workers advertise (0 when none)."""
+        return self.broker.fleet_capacity()
+
+    def submit_individuals(self, individuals: Sequence[Individual]) -> List[str]:
+        """Ship evaluation jobs without waiting; returns aligned job ids.
+
+        One broker submit per call — the engine breeds every replacement a
+        wake-up allows and ships them together, so the dispatch side stays
+        one coalesced ``jobs`` frame per worker capacity window even in
+        completion-driven mode.
+        """
+        payloads: Dict[str, Dict[str, Any]] = {}
+        ids: List[str] = []
+        ctx = _tele.current_context() if _tele.enabled() else None
+        for ind in individuals:
+            job_id = JobBroker.new_job_id()
+            payload: Dict[str, Any] = {
+                "genes": ind.get_genes(),
+                "additional_parameters": dict(ind.additional_parameters),
+            }
+            if ctx is not None:
+                payload["trace"] = ctx
+            payloads[job_id] = payload
+            ids.append(job_id)
+        if payloads:
+            self.broker.submit(payloads)
+        return ids
+
+    def wait_any_results(self, job_ids: Sequence[str], timeout: Optional[float] = None):
+        """Block until ≥1 of ``job_ids`` is terminal; ``(results, failures)``."""
+        return self.broker.wait_any(list(job_ids), timeout=timeout)
+
+    def cancel_jobs(self, job_ids: Sequence[str]) -> None:
+        """Withdraw still-open jobs whose results are no longer wanted."""
+        self.broker.cancel(job_ids)
+
     # -- the distributed fitness sweep ------------------------------------
 
     def evaluate(self) -> int:

@@ -117,6 +117,33 @@ class Population:
     def add_individual(self, individual: Individual) -> None:
         self.individuals.append(individual)
 
+    # -- steady-state (asynchronous) membership ----------------------------
+    #
+    # The async engine (algorithms_async.AsyncEvolution) treats the
+    # individuals list as an AGE-ORDERED ring: index 0 is the oldest member,
+    # appends are the youngest.  Insert/evict are incremental — no
+    # generation-sized rebuild, no clone_with — so a completed evaluation
+    # updates membership in O(1)/O(n) while other evaluations stay in flight.
+
+    def insert(self, individual: Individual) -> None:
+        """Append ``individual`` as the population's youngest member."""
+        self.individuals.append(individual)
+
+    def evict_oldest(self, require_evaluated: bool = True) -> Optional[Individual]:
+        """Remove and return the oldest member (aging eviction, Real et al.
+        2019: age, not fitness, decides who dies — the regularization that
+        forces rediscovery of good architectures).
+
+        With ``require_evaluated`` (the default) the oldest EVALUATED member
+        goes instead, skipping members whose evaluation is still in flight —
+        evicting those would orphan a result the scheduler already paid for.
+        Returns None when no member is eligible.
+        """
+        for i, ind in enumerate(self.individuals):
+            if not require_evaluated or ind.fitness_evaluated:
+                return self.individuals.pop(i)
+        return None
+
     def populate_from_grid(self, genes_grid: Optional[Mapping[str, Sequence[Any]]] = None) -> None:
         """Append one individual per point of the gene-value grid.
 

@@ -2,7 +2,7 @@
 resume, cross-run fitness persistence, offline-safe dataset loaders,
 tracing/metrics."""
 
-from .checkpoint import Checkpointer, load_checkpoint
+from .checkpoint import CHECKPOINT_SCHEMA, Checkpointer, load_checkpoint
 from .fitness_store import load_fitness_cache, save_fitness_cache
 from .profiling import EvalTimer, trace
 from .xla_cache import default_cache_dir, enable_compilation_cache
@@ -10,6 +10,7 @@ from .xla_cache import default_cache_dir, enable_compilation_cache
 __all__ = [
     "Checkpointer",
     "load_checkpoint",
+    "CHECKPOINT_SCHEMA",
     "load_fitness_cache",
     "save_fitness_cache",
     "EvalTimer",

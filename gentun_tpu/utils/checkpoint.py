@@ -10,6 +10,19 @@ randomness only from its own generator, whose state is saved).
 Model weights are deliberately NOT checkpointed: fitness evaluation is
 stateless by design (every individual trains from scratch), so there is no
 model state worth resuming — which is also why JSON suffices over orbax.
+
+Schema versioning: every checkpoint written carries ``schema_version``.
+Version history:
+
+- **1** (implicit — files without the field): generational GA state only.
+- **2**: adds the asynchronous steady-state scheduler state
+  (``AsyncEvolution``: completion counters, dispatch-ordered in-flight
+  children, ever-best individual) and the ``algorithm`` tag both loaders
+  use to refuse each other's files.
+
+Loading is backward-compatible (a v1 file loads fine) but not
+forward-compatible: a file stamped NEWER than this code understands is
+refused loudly rather than half-restored.
 """
 
 from __future__ import annotations
@@ -19,7 +32,11 @@ import os
 import tempfile
 from typing import Any, Dict, Optional
 
-__all__ = ["Checkpointer", "load_checkpoint"]
+__all__ = ["Checkpointer", "load_checkpoint", "CHECKPOINT_SCHEMA"]
+
+#: Newest checkpoint layout this code can write and read (see the module
+#: docstring for the version history).
+CHECKPOINT_SCHEMA = 2
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -52,6 +69,7 @@ class Checkpointer:
 
     def save(self, algorithm) -> None:
         state = algorithm.state_dict()
+        state["schema_version"] = CHECKPOINT_SCHEMA
         if not self.keep_history:
             state["history"] = state["history"][-1:]
         payload = json.dumps(_to_jsonable(state), separators=(",", ":"))
@@ -73,7 +91,14 @@ class Checkpointer:
         if not os.path.exists(self.path):
             return None
         with open(self.path) as f:
-            return json.load(f)
+            state = json.load(f)
+        version = state.get("schema_version", 1)  # pre-versioning files are v1
+        if version > CHECKPOINT_SCHEMA:
+            raise ValueError(
+                f"checkpoint {self.path!r} has schema version {version}, newer "
+                f"than this code understands (max {CHECKPOINT_SCHEMA}) — "
+                "refusing a partial restore; upgrade gentun_tpu to resume it")
+        return state
 
     def resume(self, algorithm) -> bool:
         """Restore ``algorithm`` from the checkpoint; True if one existed."""

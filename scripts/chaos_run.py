@@ -34,7 +34,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome  # noqa: E402
+from gentun_tpu import AsyncEvolution, GeneticAlgorithm, Individual, Population, genetic_cnn_genome  # noqa: E402
 from gentun_tpu.distributed import (  # noqa: E402
     DistributedPopulation,
     FaultInjector,
@@ -103,9 +103,9 @@ def run() -> dict:
 
     # -- the composed plan: every fault kind, against a live search --------
     worker_plan = FaultPlan([
-        FaultSpec(hook="client_send", kind="drop_connection", match_type="result", at=0),
-        FaultSpec(hook="client_send", kind="corrupt", match_type="result", at=3),
-        FaultSpec(hook="client_send", kind="duplicate_result", match_type="result", at=6),
+        FaultSpec(hook="client_send", kind="drop_connection", match_type="results", at=0),
+        FaultSpec(hook="client_send", kind="corrupt", match_type="results", at=3),
+        FaultSpec(hook="client_send", kind="duplicate_result", match_type="results", at=6),
         FaultSpec(hook="client_recv", kind="delay", at=2, delay=0.05),
         FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=1),
         FaultSpec(hook="worker_pre_eval", kind="hang", at=8, duration=2.5),
@@ -213,8 +213,81 @@ def run() -> dict:
     }
 
 
+def run_async_smoke() -> dict:
+    """Async-mode chaos smoke: the steady-state engine under injected
+    faults (a dropped ``results`` frame mid-send and an evaluation
+    failure), with telemetry on.  Asserts what generational bit-identity
+    cannot (2-worker async completion order is timing-dependent): the run
+    completes its full budget anyway, every injected fault surfaces as a
+    ``fault_injected`` telemetry event, and the broker ends quiescent."""
+    budget = 24
+    plan = FaultPlan([
+        FaultSpec(hook="client_send", kind="drop_connection", match_type="results", at=0),
+        FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=3),
+    ], seed=2026)
+    inj = FaultInjector(plan)
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_async_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-async").install()
+    port = _free_port()
+    stops = [_worker(port, injector=inj, worker_id="async-chaos-w0"),
+             _worker(port, worker_id="async-clean-w1")]
+    t0 = time.monotonic()
+    try:
+        pop = DistributedPopulation(
+            OneMax, size=POP_SIZE, seed=POP_SEED, host="127.0.0.1", port=port,
+            job_timeout=120, heartbeat_timeout=1.0)
+        try:
+            eng = AsyncEvolution(pop, tournament_size=3, seed=GA_SEED, job_timeout=120)
+            best = eng.run(max_evaluations=budget)
+            wall = time.monotonic() - t0
+            leaked = pop.broker.outstanding()
+        finally:
+            pop.close()
+    finally:
+        for s in stops:
+            s.set()
+        tele_summary = run_tele.close()
+
+    assert eng.completed == budget, f"budget not met: {eng.completed}/{budget}"
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+    fired = list(inj.fired)
+    kinds_fired = sorted({f["kind"] for f in fired})
+    assert fired, "async fault plan never fired"
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    fault_events = [r for r in tele_lines
+                    if r.get("type") == "event" and r.get("name") == "fault_injected"]
+    assert fault_events, "async telemetry artifact recorded no fault events"
+    tele_event_kinds = sorted({e["data"]["kind"] for e in fault_events})
+    assert tele_event_kinds == kinds_fired, (
+        f"telemetry fault events {tele_event_kinds} != faults fired {kinds_fired}")
+
+    return {
+        "mode": "async",
+        "budget": budget,
+        "population_size": POP_SIZE,
+        "workers": 2,
+        "fault_plan": plan.to_dict(),
+        "faults_fired": fired,
+        "fault_kinds_fired": kinds_fired,
+        "completed": eng.completed,
+        "best_fitness": best.get_fitness(),
+        "broker_state_after_run": leaked,
+        "wall_s": round(wall, 3),
+        "telemetry": {
+            "fault_events": len(fault_events),
+            "fault_event_kinds": tele_event_kinds,
+            "n_spans": tele_summary["n_spans"],
+        },
+    }
+
+
 if __name__ == "__main__":
     out = run()
+    out["async_smoke"] = run_async_smoke()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

@@ -149,7 +149,7 @@ class TestFaultPlan:
         plan = FaultPlan(
             [
                 FaultSpec(hook="client_send", kind="duplicate_result",
-                          match_type="result", at=2, times=3),
+                          match_type="results", at=2, times=3),
                 FaultSpec(hook="master_boundary", kind="kill_master", generation=4),
             ],
             seed=99,
@@ -234,7 +234,7 @@ class TestChaosSmoke:
         # the worker dies exactly when sending its first result: the broker
         # must requeue the lost job and the reconnected worker must finish
         fired = _run_scenario(
-            [dict(hook="client_send", kind="drop_connection", match_type="result", at=0)],
+            [dict(hook="client_send", kind="drop_connection", match_type="results", at=0)],
         )
         assert any(f["kind"] == "drop_connection" for f in fired)
 
@@ -268,7 +268,7 @@ class TestChaosMatrix:
         # client→broker direction: the broker must drop the connection,
         # requeue, and accept the redelivered result
         fired = _run_scenario(
-            [dict(hook="client_send", kind="corrupt", match_type="result", at=0)],
+            [dict(hook="client_send", kind="corrupt", match_type="results", at=0)],
         )
         assert any(f["kind"] == "corrupt" for f in fired)
 
@@ -339,7 +339,7 @@ class TestChaosMatrix:
         # the replayed twin frame must be dropped by the broker's
         # _payloads-membership dedup, not double-applied
         fired = _run_scenario(
-            [dict(hook="client_send", kind="duplicate_result", match_type="result",
+            [dict(hook="client_send", kind="duplicate_result", match_type="results",
                   at=0, times=2)],
         )
         assert sum(f["kind"] == "duplicate_result" for f in fired) == 2
@@ -385,7 +385,7 @@ class TestChaosE2E:
         # redelivery (its third evaluation raises)
         w0_inj = FaultInjector(FaultPlan([
             FaultSpec(hook="client_send", kind="drop_connection",
-                      match_type="result", at=0),
+                      match_type="results", at=0),
             FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=2),
         ]))
         # the master dies at the generation-2 boundary (checkpoint written)

@@ -1,0 +1,104 @@
+"""Wire-protocol framing edge cases (``distributed/protocol.py``).
+
+The socket paths are exercised end-to-end by test_distributed/test_chaos;
+this file pins the codec itself: partial frames, the size cap on both
+sides, and the ``results`` coalescing introduced for the async engine
+(one frame per capacity window, split at a soft byte cap, spans riding
+the first frame only).
+"""
+
+import json
+
+import pytest
+
+from gentun_tpu.distributed.protocol import (
+    MAX_MESSAGE_BYTES,
+    ProtocolError,
+    coalesce_results,
+    decode,
+    encode,
+)
+
+
+class TestFraming:
+    def test_round_trip(self):
+        msg = {"type": "result", "job_id": "j1", "fitness": 0.25}
+        assert decode(encode(msg)) == msg
+
+    def test_decode_partial_frame_is_protocol_error(self):
+        # A frame cut mid-JSON (reader returned early / injected corruption)
+        whole = encode({"type": "result", "job_id": "j1", "fitness": 0.25})
+        with pytest.raises(ProtocolError, match="bad JSON"):
+            decode(whole[: len(whole) // 2])
+
+    def test_decode_empty_frame_is_protocol_error(self):
+        with pytest.raises(ProtocolError):
+            decode(b"\n")
+
+    def test_decode_untyped_message_is_protocol_error(self):
+        with pytest.raises(ProtocolError, match="typed"):
+            decode(b'{"job_id": "j1"}\n')
+        with pytest.raises(ProtocolError, match="typed"):
+            decode(b'[1, 2, 3]\n')
+
+    def test_encode_oversized_raises(self):
+        msg = {"type": "jobs", "blob": "x" * MAX_MESSAGE_BYTES}
+        with pytest.raises(ProtocolError, match="exceeds"):
+            encode(msg)
+
+    def test_decode_oversized_raises(self):
+        line = b"x" * (MAX_MESSAGE_BYTES + 1) + b"\n"
+        with pytest.raises(ProtocolError, match="exceeds"):
+            decode(line)
+
+    def test_exactly_max_bytes_round_trips(self):
+        # encode() allows payloads of exactly MAX_MESSAGE_BYTES; decode()
+        # must strip the framing newline BEFORE the size check so the same
+        # frame comes back in.
+        overhead = len(json.dumps({"type": "t", "pad": ""}, separators=(",", ":")))
+        msg = {"type": "t", "pad": "x" * (MAX_MESSAGE_BYTES - overhead)}
+        data = encode(msg)
+        assert len(data) == MAX_MESSAGE_BYTES + 1  # payload + newline
+        assert decode(data) == msg
+
+
+class TestCoalesceResults:
+    def test_small_batch_is_one_frame(self):
+        entries = [{"job_id": f"j{i}", "fitness": float(i)} for i in range(8)]
+        frames = coalesce_results(entries)
+        assert len(frames) == 1
+        assert frames[0]["type"] == "results"
+        assert frames[0]["results"] == entries
+        assert "spans" not in frames[0]
+
+    def test_spans_ride_first_frame_only(self):
+        entries = [{"job_id": f"j{i}", "fitness": float(i)} for i in range(40)]
+        spans = [{"kind": "eval", "dur_s": 0.1}]
+        # Force multiple frames with a tiny soft cap.
+        frames = coalesce_results(entries, spans=spans, soft_cap=128)
+        assert len(frames) > 1
+        assert frames[0]["spans"] == spans
+        assert all("spans" not in f for f in frames[1:])
+
+    def test_split_frames_reassemble_in_order(self):
+        entries = [{"job_id": f"j{i}", "fitness": float(i)} for i in range(100)]
+        frames = coalesce_results(entries, soft_cap=256)
+        reassembled = [e for f in frames for e in f["results"]]
+        assert reassembled == entries
+
+    def test_every_split_frame_is_encodable(self):
+        # Entries near the hard cap must split rather than produce an
+        # oversized frame.
+        entries = [
+            {"job_id": f"j{i}", "fitness": 1.0, "pad": "x" * (MAX_MESSAGE_BYTES // 3)}
+            for i in range(4)
+        ]
+        frames = coalesce_results(entries)
+        assert len(frames) >= 2
+        for f in frames:
+            assert decode(encode(f)) == f
+        assert [e for f in frames for e in f["results"]] == entries
+
+    def test_empty_entries_yield_no_frames(self):
+        assert coalesce_results([]) == []
+        assert coalesce_results([], spans=[{"kind": "eval"}]) == []
