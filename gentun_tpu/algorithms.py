@@ -62,6 +62,17 @@ class GeneticAlgorithm:
     unchanged if ``elitism``, then fill the next generation with children of
     tournament-selected parents (sample ``tournament_size`` members, fittest
     wins — SURVEY.md §2.3 "Selection").
+
+    ``breed_ahead`` (off by default; trajectories are bit-identical when
+    off): as soon as a generation is bred, pre-dispatch its cache-missed
+    children to the fleet (``Population.predispatch``) so workers' prefetch
+    queues refill during the master's checkpoint/log window instead of
+    sitting idle across the generation boundary — the generational half of
+    the pipelined dispatch plane (DISTRIBUTED.md "Pipelined dispatch").
+    The next ``evaluate()`` adopts the in-flight jobs; selection order,
+    RNG draws, and fitness values are unchanged either way, because the
+    generational trajectory is completion-order independent.  A no-op for
+    local populations.
     """
 
     def __init__(
@@ -70,10 +81,12 @@ class GeneticAlgorithm:
         tournament_size: int = 5,
         elitism: bool = True,
         seed: Optional[int] = None,
+        breed_ahead: bool = False,
     ):
         self.population = population
         self.tournament_size = tournament_size
         self.elitism = elitism
+        self.breed_ahead = bool(breed_ahead)
         self.rng = np.random.default_rng(seed) if seed is not None else population.rng
         self.generation = 0
         self.history: List[Dict[str, Any]] = []
@@ -143,6 +156,15 @@ class GeneticAlgorithm:
             # generations (a DistributedPopulation must carry its broker
             # forward).
             self.population = self.population.clone_with(next_individuals)
+            if self.breed_ahead:
+                # Ship the freshly-bred generation's jobs BEFORE the
+                # checkpoint/log bookkeeping below: the wire time and the
+                # workers' decode overlap work the master was going to do
+                # anyway.  Resume safety: a pre-dispatch is never
+                # checkpointed — a resumed run's evaluate() simply
+                # re-submits fresh jobs (at-least-once, dedup on cache key).
+                with _tele.span("predispatch"):
+                    self.population.predispatch()
             self.generation += 1
             if self._checkpointer is not None:
                 with _tele.span("checkpoint"):
@@ -240,6 +262,7 @@ class GeneticAlgorithm:
             "generation": self.generation,
             "tournament_size": self.tournament_size,
             "elitism": self.elitism,
+            "breed_ahead": self.breed_ahead,
             "rng_state": self.rng.bit_generator.state,
             "history": self.history,
             "population": {
@@ -267,6 +290,8 @@ class GeneticAlgorithm:
         self.generation = int(state["generation"])
         self.tournament_size = int(state["tournament_size"])
         self.elitism = bool(state["elitism"])
+        if "breed_ahead" in state:  # absent in pre-pipelining checkpoints
+            self.breed_ahead = bool(state["breed_ahead"])
         self.rng.bit_generator.state = state["rng_state"]
         self.history = list(state["history"])
         pop_state = state["population"]

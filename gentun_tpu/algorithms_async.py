@@ -152,7 +152,14 @@ class _DistributedEvaluator:
             elif cap > 0 and time.monotonic() - last_growth >= 0.75:
                 break
             time.sleep(0.05)
-        return max(1, cap)
+        # Breed ahead to the fleet's full dispatch WINDOW — evaluation
+        # slots plus the workers' advertised prefetch queues — so every
+        # worker always has a decoded next window waiting (the engine half
+        # of the pipelined dispatch plane).  A fleet advertising no
+        # prefetch yields exactly the old target, keeping prefetch_depth=0
+        # trajectories bit-identical.
+        prefetch = getattr(self._pop, "fleet_prefetch", lambda: 0)()
+        return max(1, cap) + max(0, int(prefetch))
 
     def submit(self, individuals: List[Individual]) -> List[str]:
         ids = self._pop.submit_individuals(individuals)

@@ -96,18 +96,29 @@ class GatherTimeout(TimeoutError):
 class _Worker:
     """Per-connection state, touched only from the broker loop thread."""
 
-    __slots__ = ("worker_id", "writer", "capacity", "credit", "in_flight", "last_seen", "n_chips", "backend")
+    __slots__ = ("worker_id", "writer", "capacity", "prefetch_depth", "credit",
+                 "in_flight", "last_seen", "n_chips", "backend")
 
     def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
-                 n_chips: int = 1, backend: Optional[str] = None):
+                 n_chips: int = 1, backend: Optional[str] = None,
+                 prefetch_depth: int = 0):
         self.worker_id = worker_id
         self.writer = writer
         self.capacity = capacity
+        #: jobs the worker wants queued locally BEYOND its evaluation
+        #: capacity (pipelined dispatch, protocol.py "Pipelined-dispatch
+        #: field"); 0 for workers that never advertised one.
+        self.prefetch_depth = prefetch_depth
         self.credit = 0
         self.in_flight: Set[str] = set()
         self.last_seen = time.monotonic()
         self.n_chips = n_chips
         self.backend = backend
+
+    @property
+    def window(self) -> int:
+        """Credit ceiling: evaluation slots plus the local prefetch queue."""
+        return self.capacity + self.prefetch_depth
 
 
 class JobBroker:
@@ -172,6 +183,12 @@ class JobBroker:
         # job, feeding queue_wait and job spans.  Populated only while
         # telemetry is enabled; pruned wherever _payloads is pruned.
         self._tele_enqueued: Dict[str, float] = {}
+        # Monotonic handoff-to-worker stamp per dispatched job, feeding the
+        # dispatch_rtt_s histogram (handoff → result: worker queue residence
+        # + evaluation + frame transit).  Same lifecycle discipline as
+        # _tele_enqueued; a requeue removes the stamp (the job is no longer
+        # dispatched).
+        self._tele_dispatched: Dict[str, float] = {}
 
         # Cross-thread results channel
         self._cond = threading.Condition()
@@ -439,6 +456,7 @@ class JobBroker:
             for j in ids:
                 self._payloads.pop(j, None)
                 self._tele_enqueued.pop(j, None)
+                self._tele_dispatched.pop(j, None)
             if any(j in ids for j in self._pending):
                 # Drain cancelled ids now: with no worker connected nothing
                 # else pops the deque, and a retry loop would grow it by one
@@ -449,7 +467,7 @@ class JobBroker:
                 # so the worker's next batch isn't shrunk for one cycle.
                 cancelled_here = len(w.in_flight & ids)
                 w.in_flight -= ids
-                w.credit = min(w.capacity, w.credit + cancelled_here)
+                w.credit = min(w.window, w.credit + cancelled_here)
             # Late sweep: a result that was mid-delivery when gather pruned
             # (past the payload check, blocked on _cond) lands in _results
             # BEFORE this callback runs — handler and callbacks share the
@@ -478,6 +496,19 @@ class JobBroker:
         any thread.
         """
         return sum(w.capacity for w in list(self._workers.values()))
+
+    def fleet_prefetch(self) -> int:
+        """Total prefetch slots advertised by the connected workers (0 when
+        none, and 0 for a fleet of pre-pipelining workers).
+
+        The asynchronous engine adds this to :meth:`fleet_capacity` for its
+        default in-flight target: breeding ahead to ``capacity + prefetch``
+        is what keeps every worker's local ready-queue non-empty, so a
+        finished window starts the next one without waiting out a
+        results→breed→dispatch round trip.  Snapshot read — safe from any
+        thread.
+        """
+        return sum(w.prefetch_depth for w in list(self._workers.values()))
 
     def fleet_chips(self) -> int:
         """Total accelerator chips advertised by the connected workers (≥1).
@@ -525,6 +556,22 @@ class JobBroker:
     def new_job_id() -> str:
         return uuid.uuid4().hex
 
+    @staticmethod
+    def _parse_prefetch(hello: Dict[str, Any], capacity: int) -> int:
+        """The worker's advertised ``prefetch_depth``, validated and capped.
+
+        Missing (old worker) or malformed values degrade to 0 — the
+        pre-pipelining credit flow — never to a dropped connection.  The
+        cap (4 × capacity) bounds how much of the queue one worker can
+        hoard: prefetch hides one results→breed→dispatch round trip, so
+        depth beyond a few windows only starves the rest of the fleet.
+        """
+        try:
+            depth = int(hello.get("prefetch_depth", 0))
+        except (TypeError, ValueError):
+            return 0
+        return max(0, min(depth, 4 * capacity))
+
     # -- loop-thread internals --------------------------------------------
 
     def _update_flow_gauges(self) -> None:
@@ -542,6 +589,15 @@ class JobBroker:
         depth = len(self._pending)
         reg.gauge("queue_depth").set(depth)
         reg.gauge("broker_queue_depth").set(depth)
+        # Dispatched jobs beyond the workers' evaluation capacity are (from
+        # the broker's vantage) sitting in worker-local ready-queues — the
+        # double-buffering inventory.  Persistently 0 with prefetching
+        # workers connected means the ENGINE is the bottleneck (not breeding
+        # ahead fast enough); pinned at fleet_prefetch() means workers never
+        # drain their queues (compute-bound — prefetch is pure win).
+        reg.gauge("prefetch_queue_depth").set(
+            sum(max(0, len(w.in_flight) - w.capacity)
+                for w in self._workers.values()))
 
     def _dispatch(self) -> None:
         """Hand pending jobs to workers with spare credit (competing consumers).
@@ -584,6 +640,8 @@ class JobBroker:
                         # histogram dashboards can read without span
                         # post-processing (tail-regime pressure signal).
                         _get_registry().histogram("queue_wait_s").observe(wait)
+                    # dispatch_rtt_s starts here: handoff to the worker.
+                    self._tele_dispatched[job_id] = time.monotonic()
                 entry = {"job_id": job_id, **self._payloads[job_id]}
                 entry_bytes = len(encode(entry))
                 if batch and batch_bytes + entry_bytes > soft_cap:
@@ -611,12 +669,17 @@ class JobBroker:
         for job_id in sorted(w.in_flight):
             if job_id in self._payloads:
                 logger.warning("requeue job %s (%s, worker %s)", job_id, reason, w.worker_id)
-                # Disconnect redelivery is unbounded, like AMQP's.
+                # Disconnect redelivery is unbounded, like AMQP's.  This
+                # covers the worker's whole in-flight set — the jobs it was
+                # evaluating AND the ones still queued-but-unstarted in its
+                # local prefetch queue (the broker cannot tell them apart,
+                # and at-least-once makes the distinction irrelevant).
                 self._pending.append(job_id)
                 if tele:
                     # Restart the clock: queue_wait/job measure time since
                     # the LAST enqueue, not since first submission.
                     self._tele_enqueued[job_id] = time.monotonic()
+                self._tele_dispatched.pop(job_id, None)
         w.in_flight.clear()
         if tele:
             self._update_flow_gauges()
@@ -656,12 +719,14 @@ class JobBroker:
             except (TypeError, ValueError):
                 n_chips = 1  # malformed advertisement: degrade, don't drop
             backend = hello.get("backend") or None
+            capacity = max(1, int(hello.get("capacity", 1)))
             worker = _Worker(
                 worker_id=str(hello.get("worker_id", f"worker-{wid}")),
                 writer=writer,
-                capacity=max(1, int(hello.get("capacity", 1))),
+                capacity=capacity,
                 n_chips=n_chips,
                 backend=str(backend) if backend is not None else None,
+                prefetch_depth=self._parse_prefetch(hello, capacity),
             )
             # Heterogeneous-fleet check (ADVICE r3): two workers scoring one
             # generation with different estimators (e.g. xgb.cv on one host,
@@ -680,8 +745,9 @@ class JobBroker:
                 _get_registry().gauge("broker_workers_connected").set(len(self._workers))
             writer.write(encode({"type": "welcome"}))
             logger.info(
-                "worker %s connected (capacity %d, %d chip(s))",
-                worker.worker_id, worker.capacity, worker.n_chips,
+                "worker %s connected (capacity %d, prefetch %d, %d chip(s))",
+                worker.worker_id, worker.capacity, worker.prefetch_depth,
+                worker.n_chips,
             )
 
             while True:
@@ -713,7 +779,13 @@ class JobBroker:
                         add = int(msg.get("credit", 1))
                     except (TypeError, ValueError):
                         add = 1  # malformed credit: degrade, don't drop the worker
-                    worker.credit = min(worker.capacity, worker.credit + add)
+                    # Credit ceiling is the worker's WINDOW (capacity +
+                    # prefetch_depth): over-subscription keeps the worker's
+                    # local ready-queue stocked so the device never waits
+                    # for a results→breed→dispatch round trip.  With
+                    # prefetch_depth 0 (or an old worker that never sent
+                    # one) this is exactly the pre-pipelining clamp.
+                    worker.credit = min(worker.window, worker.credit + add)
                     self._dispatch()
                 elif mtype == "result":
                     self._on_result(worker, msg)
@@ -776,6 +848,18 @@ class JobBroker:
                                   trace=payload.get("trace"),
                                   attrs={"worker": w.worker_id})
                 _get_registry().histogram("broker_job_latency_seconds").observe(dur)
+            t_disp = self._tele_dispatched.pop(job_id, None)
+            if t_disp is not None:
+                # The pipelining acceptance signal: handoff → result.  With
+                # prefetch, a job's RTT INCLUDES its residence in the
+                # worker's local ready-queue, so per-job RTT grows while
+                # fleet throughput does too — read it with queue depth
+                # (docs/OBSERVABILITY.md "interpretation rules of thumb").
+                rtt = time.monotonic() - t_disp
+                _tele.record_span("dispatch_rtt", t_disp, rtt,
+                                  trace=payload.get("trace"),
+                                  attrs={"worker": w.worker_id})
+                _get_registry().histogram("dispatch_rtt_s").observe(rtt)
             reported = msg.get("spans")
             if reported:
                 _tele.ingest(reported)
@@ -802,6 +886,7 @@ class JobBroker:
             logger.error("job %s failed %d times: %s", job_id, self._fail_counts[job_id], reason)
             del self._payloads[job_id]
             self._tele_enqueued.pop(job_id, None)
+            self._tele_dispatched.pop(job_id, None)
             if _tele.enabled():
                 self._update_flow_gauges()
             with self._cond:
@@ -810,6 +895,7 @@ class JobBroker:
         else:
             logger.warning("job %s failed (%s); requeueing", job_id, reason)
             self._pending.append(job_id)
+            self._tele_dispatched.pop(job_id, None)
             if _tele.enabled():
                 self._tele_enqueued[job_id] = time.monotonic()
             self._dispatch()

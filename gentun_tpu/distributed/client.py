@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Type
 from ..individuals import Individual
 from ..populations import Population
 from ..telemetry import spans as _tele
+from ..telemetry.registry import get_registry as _get_registry
 from .protocol import (
     MAX_MESSAGE_BYTES,
     AuthError,
@@ -88,6 +89,16 @@ class GentunClient:
     - ``species``: the Individual subclass to rebuild from wire genes.
     - ``capacity``: max jobs held at once (1 = reference semantics; >1 lets
       a TPU worker train a whole batch in one compiled program).
+    - ``prefetch_depth``: jobs queued locally BEYOND ``capacity`` so the
+      next window is already decoded when the current one finishes
+      (double buffering — a background receive thread feeds a local
+      ready-queue while the evaluate loop trains, hiding the
+      results→breed→dispatch round trip).  ``None`` (default) means
+      ``capacity``; ``0`` restores the exact pre-pipelining serial loop
+      (bit-identical frame sequence).  Clamped to ``[0, 4 × capacity]``,
+      mirroring the broker's own clamp.  An old broker that ignores the
+      hello field simply never grants the extra credit — the worker
+      degrades to the serial flow without protocol errors.
     - ``heartbeat_interval``: seconds between pings from the side thread.
     - ``reconnect_delay``: INITIAL delay after a lost connection; subsequent
       attempts back off exponentially with decorrelated jitter up to
@@ -114,6 +125,7 @@ class GentunClient:
         user: Optional[str] = None,
         password: Optional[str] = None,
         capacity: int = 1,
+        prefetch_depth: Optional[int] = None,
         heartbeat_interval: float = 3.0,
         reconnect_delay: float = 1.0,
         reconnect_max_delay: float = 30.0,
@@ -130,6 +142,9 @@ class GentunClient:
         self.port = int(port)
         self.token = password
         self.capacity = max(1, int(capacity))
+        if prefetch_depth is None:
+            prefetch_depth = self.capacity
+        self.prefetch_depth = max(0, min(int(prefetch_depth), 4 * self.capacity))
         self.heartbeat_interval = float(heartbeat_interval)
         self.reconnect_delay = float(reconnect_delay)
         self.reconnect_max_delay = float(reconnect_max_delay)
@@ -179,6 +194,7 @@ class GentunClient:
         self._stop = threading.Event()
         self._handshaken = threading.Event()  # gates heartbeats until welcome
         self._jobs_done = 0
+        self._last_batch_end: Optional[float] = None  # worker_idle_s anchor
 
     # -- connection --------------------------------------------------------
 
@@ -219,6 +235,7 @@ class GentunClient:
             "worker_id": self.worker_id,
             "token": self.token,
             "capacity": self.capacity,
+            "prefetch_depth": self.prefetch_depth,
             "n_chips": n_chips,
             "backend": backend,
         })
@@ -228,6 +245,9 @@ class GentunClient:
                 raise AuthError(f"broker rejected credentials: {reply.get('reason')}")
             raise ConnectionError(f"broker rejected worker: {reply}")
         self._handshaken.set()
+        # A reconnect gap is downtime, not a dispatch bubble: don't let it
+        # pollute the worker_idle_s histogram.
+        self._last_batch_end = None
         logger.info("worker %s connected to %s:%d", self.worker_id, self.host, self.port)
 
     def _close(self) -> None:
@@ -281,8 +301,12 @@ class GentunClient:
                 raise OSError("not connected")
             sock.sendall(data)
 
-    def _recv(self) -> Dict[str, Any]:
-        line = self._rfile.readline(MAX_MESSAGE_BYTES + 2)
+    def _recv(self, rfile=None) -> Dict[str, Any]:
+        # `rfile` pins the read to ONE connection's stream: the pipelined
+        # receiver thread captures it at spawn so a thread that outlives a
+        # reconnect can never steal frames from the NEW connection.
+        rfile = self._rfile if rfile is None else rfile
+        line = rfile.readline(MAX_MESSAGE_BYTES + 2)
         if not line:
             raise ConnectionError("broker closed connection")
         msg = decode(line)
@@ -387,6 +411,19 @@ class GentunClient:
             watchdog_stop.set()
 
     def _consume(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
+        if self.prefetch_depth == 0:
+            self._consume_serial(stop, max_jobs)
+        else:
+            self._consume_pipelined(stop, max_jobs)
+
+    def _consume_serial(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
+        """The pre-pipelining loop, preserved verbatim for ``prefetch_depth=0``.
+
+        One ``ready`` → one blocking read → one evaluation per iteration:
+        the worker sits idle for a full results→breed→dispatch round trip
+        between windows, but the frame sequence is exactly the historical
+        one — the bit-identity anchor for determinism and chaos tests.
+        """
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
             self._send({"type": "ready", "credit": self.capacity})
             # The broker delivers everything our credit allows as ONE `jobs`
@@ -402,6 +439,74 @@ class GentunClient:
                 # processes must enter the same jitted programs together.
                 self._mh.broadcast_payload(jobs)
             self._evaluate_batch(jobs)
+
+    def _consume_pipelined(self, stop: threading.Event, max_jobs: Optional[int]) -> None:
+        """Double-buffered consume: receive decodes while evaluate trains.
+
+        A background thread owns THIS connection's read side and feeds a
+        local ready-queue of decoded job batches; the evaluate loop drains
+        it.  The initial ``ready`` advertises the full window
+        (``capacity + prefetch_depth``), so the broker keeps a next window
+        queued at the worker while the current one trains — when a batch
+        finishes, its successor is already decoded and the next program
+        enqueues immediately (jax async dispatch overlaps host-side decode
+        and result framing with device compute).  Each completed batch
+        replenishes exactly its own credit, holding broker-side credit at
+        the window ceiling.
+
+        Fault composition: the receiver thread forwards its terminal
+        exception through the queue, so broker death or an injected recv
+        fault re-raises in this loop and takes the normal ``work()``
+        reconnect path.  Batches still sitting in the local queue at
+        disconnect are simply dropped — the broker's requeue-on-disconnect
+        covers every dispatched-unacked job, queued-but-unstarted ones
+        included (at-least-once, unchanged).
+        """
+        import queue as _queue
+
+        rfile = self._rfile  # pin: never read a future connection's stream
+        ready_q: "_queue.Queue" = _queue.Queue()
+
+        def _receiver() -> None:
+            try:
+                while True:
+                    msg = self._recv(rfile=rfile)
+                    if msg["type"] == "jobs":
+                        jobs = list(msg["jobs"])
+                        # Over-subscribed credit can coalesce up to
+                        # capacity + prefetch_depth jobs into one frame;
+                        # evaluate in capacity-sized programs so prefetch
+                        # changes WHEN work is decoded, never the compiled
+                        # batch shape — or a poison genome's all-or-nothing
+                        # blast radius (ack-after-work failure reporting
+                        # stays per evaluation group).
+                        for i in range(0, len(jobs), self.capacity):
+                            ready_q.put(jobs[i:i + self.capacity])
+                    elif msg["type"] != "welcome":
+                        logger.warning("unexpected message %r", msg["type"])
+            except BaseException as e:  # forwarded, re-raised by the consumer
+                ready_q.put(e)
+
+        rx = threading.Thread(target=_receiver, name="gentun-recv", daemon=True)
+        rx.start()
+        # The receiver exits via its pinned rfile: when work() closes this
+        # socket (reconnect or teardown), the blocked readline raises/EOFs
+        # and the thread dies with it — no separate stop signal needed.
+        self._send({"type": "ready", "credit": self.capacity + self.prefetch_depth})
+        while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
+            try:
+                item = ready_q.get(timeout=0.25)
+            except _queue.Empty:
+                continue  # poll stop/max_jobs while the fleet is idle
+            if isinstance(item, BaseException):
+                raise item
+            jobs = item
+            if self.multihost:
+                # Ship the batch to every rank BEFORE evaluating: all
+                # processes must enter the same jitted programs together.
+                self._mh.broadcast_payload(jobs)
+            self._evaluate_batch(jobs)
+            self._send({"type": "ready", "credit": len(jobs)})
 
     def _await_jobs(self) -> List[Dict[str, Any]]:
         while True:
@@ -422,6 +527,20 @@ class GentunClient:
         ``Population.evaluate`` so the species' batched (vmapped) path is
         used when available; singletons fall back to ``get_fitness()``.
         """
+        # worker_idle_s: the gap between consecutive evaluation batches on
+        # this connection — the dispatch bubble the pipelined consume loop
+        # exists to hide.  Anchored at the previous batch's END so training
+        # time never counts as idleness; reconnect gaps are excluded
+        # (anchor reset in _connect).
+        t_start = time.monotonic()
+        if _tele.enabled() and self._last_batch_end is not None:
+            idle = t_start - self._last_batch_end
+            _tele.record_span(
+                "worker_idle", self._last_batch_end, idle,
+                trace=jobs[0].get("trace") if jobs else None,
+                attrs={"worker": self.worker_id},
+            )
+            _get_registry().histogram("worker_idle_s").observe(idle)
         # Grouping stays client-side (rather than delegating wholly to
         # Population.evaluate) so a raising group fails ONLY its own jobs;
         # the key matches populations._group_by_params: _freeze, collision-
@@ -519,6 +638,7 @@ class GentunClient:
                 logger.exception("batch evaluation failed")
                 for job in ok_jobs:
                     self._try_send_fail(job["job_id"], f"evaluate: {e!r}")
+        self._last_batch_end = time.monotonic()
 
     def _try_send_fail(self, job_id: str, reason: str) -> None:
         if not self._is_leader:

@@ -26,6 +26,22 @@ worker → broker       ``ping`` {}               liveness, from a side thread
 per-chip metric) and ``backend`` (fitness-model class name — the broker
 warns on a heterogeneous fleet).
 
+Pipelined-dispatch field (new fields are OPTIONAL with conservative
+defaults, the same versioning convention as the telemetry fields below —
+old workers and old masters interoperate unchanged):
+
+- ``hello`` may carry ``prefetch_depth`` (int ≥ 0): how many jobs BEYOND
+  ``capacity`` this worker wants queued locally so the next window is
+  already decoded when the current one finishes (double buffering —
+  ``client.py``).  A broker that understands it extends the worker's
+  credit ceiling to ``capacity + prefetch_depth``
+  (``broker._parse_prefetch`` clamps to ``[0, 4 × capacity]``); an old
+  broker ignores the field and clamps credit at ``capacity``, which
+  degrades the worker to the un-pipelined flow without any protocol
+  error.  A worker that never sends it (old worker, or
+  ``prefetch_depth=0``) gets exactly the pre-pipelining behavior on
+  both ends.
+
 Telemetry fields (``gentun_tpu/telemetry``, docs/OBSERVABILITY.md) — both
 OPTIONAL and only present when tracing is enabled on the sending side;
 receivers that don't understand them ignore them, so mixed

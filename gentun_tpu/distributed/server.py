@@ -185,6 +185,17 @@ class DistributedPopulation(Population):
         """Total job slots the connected workers advertise (0 when none)."""
         return self.broker.fleet_capacity()
 
+    def fleet_prefetch(self) -> int:
+        """Total prefetch-queue slots the fleet advertises beyond capacity.
+
+        The engine's breed-ahead target is ``fleet_capacity() +
+        fleet_prefetch()`` — enough in-flight work that every worker holds
+        a decoded next window while its current one trains.  0 for a
+        fleet of old or ``prefetch_depth=0`` workers, which keeps the
+        pre-pipelining in-flight target (and trajectories) unchanged.
+        """
+        return self.broker.fleet_prefetch()
+
     def submit_individuals(self, individuals: Sequence[Individual]) -> List[str]:
         """Ship evaluation jobs without waiting; returns aligned job ids.
 
@@ -298,24 +309,15 @@ class DistributedPopulation(Population):
                 "population_cache_hits_total", species=self.species.__name__,
             ).inc(n_before - len(pending))
         if not pending:
+            self._drop_predispatch()
             return 0
-        payloads: Dict[str, Dict[str, Any]] = {}
-        by_id: Dict[str, Individual] = {}
-        dup_map: Dict[str, List[Individual]] = {}
-        rep_job: Dict[Any, str] = {}
-        for ind in pending:
-            key = self._safe_cache_key(ind)
-            if key is not None and key in rep_job:
-                dup_map.setdefault(rep_job[key], []).append(ind)
-                continue
-            job_id = JobBroker.new_job_id()
-            if key is not None:
-                rep_job[key] = job_id
-            payloads[job_id] = {
-                "genes": ind.get_genes(),
-                "additional_parameters": dict(ind.additional_parameters),
-            }
-            by_id[job_id] = ind
+        adopted = self._adopt_predispatch(pending)
+        if adopted is not None:
+            by_id, dup_map = adopted
+            self._spec_job_ids = set()
+            logger.info("adopting %d pre-dispatched job(s) for this sweep", len(by_id))
+            return self._gather_apply(list(by_id), by_id, dup_map)
+        payloads, by_id, dup_map, rep_job = self._build_payloads(pending)
         if tele and len(pending) > len(payloads):
             _get_registry().counter(
                 "population_dedup_collapsed_total", species=self.species.__name__,
@@ -372,6 +374,44 @@ class DistributedPopulation(Population):
                 for payload in payloads.values():
                     payload["trace"] = ctx
         self.broker.submit(payloads)
+        # Speculative jobs don't count as population work: the GA's
+        # individuals/hour metric stays a statement about real individuals.
+        return self._gather_apply(real_ids, by_id, dup_map)
+
+    def _build_payloads(self, pending: Sequence[Individual]):
+        """Wire payloads for ``pending`` with in-sweep dedup.
+
+        Returns ``(payloads, by_id, dup_map, rep_job)``: duplicates within
+        the sweep collapse to one representative job
+        (``Individual.cache_key`` — SURVEY.md §7 hard part #1); only
+        genuinely new work reaches the workers.
+        """
+        payloads: Dict[str, Dict[str, Any]] = {}
+        by_id: Dict[str, Individual] = {}
+        dup_map: Dict[str, List[Individual]] = {}
+        rep_job: Dict[Any, str] = {}
+        for ind in pending:
+            key = self._safe_cache_key(ind)
+            if key is not None and key in rep_job:
+                dup_map.setdefault(rep_job[key], []).append(ind)
+                continue
+            job_id = JobBroker.new_job_id()
+            if key is not None:
+                rep_job[key] = job_id
+            payloads[job_id] = {
+                "genes": ind.get_genes(),
+                "additional_parameters": dict(ind.additional_parameters),
+            }
+            by_id[job_id] = ind
+        return payloads, by_id, dup_map, rep_job
+
+    def _gather_apply(
+        self,
+        real_ids: List[str],
+        by_id: Dict[str, Individual],
+        dup_map: Dict[str, List[Individual]],
+    ) -> int:
+        """Barrier + fitness application for one sweep's real jobs."""
         try:
             results = self.broker.gather(real_ids, timeout=self.job_timeout)
         except JobFailed as e:
@@ -397,9 +437,75 @@ class DistributedPopulation(Population):
             raise
         self._apply_results(results, by_id, dup_map)
         self._collect_speculative(by_id, timeout=10.0)
-        # Speculative jobs don't count as population work: the GA's
-        # individuals/hour metric stays a statement about real individuals.
         return len(real_ids)
+
+    # -- breed-ahead pre-dispatch (pipelined generational mode) ------------
+
+    def predispatch(self) -> int:
+        """Ship this population's cache-missed work NOW, without waiting.
+
+        The generational half of the pipelined dispatch plane
+        (``GeneticAlgorithm(breed_ahead=True)``): called right after the
+        next generation is bred, so its jobs travel while the master
+        checkpoints/logs and the workers' prefetch queues refill during
+        what used to be the inter-generation bubble.  The next
+        ``evaluate()`` call adopts the in-flight jobs instead of
+        re-submitting; if the population was mutated in between, the
+        stale jobs are cancelled and evaluate() falls back to the normal
+        build-and-submit path.  Returns the number of jobs shipped.
+        """
+        tele = _tele.enabled()
+        pending = [ind for ind in self.individuals if not ind.fitness_evaluated]
+        n_before = len(pending)
+        pending = self._fill_from_cache(pending)
+        if tele and n_before > len(pending):
+            _get_registry().counter(
+                "population_cache_hits_total", species=self.species.__name__,
+            ).inc(n_before - len(pending))
+        if not pending:
+            self._pre = None
+            return 0
+        payloads, by_id, dup_map, _rep = self._build_payloads(pending)
+        if tele and len(pending) > len(payloads):
+            _get_registry().counter(
+                "population_dedup_collapsed_total", species=self.species.__name__,
+            ).inc(len(pending) - len(payloads))
+        if tele:
+            ctx = _tele.current_context()
+            if ctx is not None:
+                for payload in payloads.values():
+                    payload["trace"] = ctx
+        self.broker.submit(payloads)
+        self._pre = (by_id, dup_map)
+        logger.info("pre-dispatched %d job(s) for the next generation", len(payloads))
+        return len(payloads)
+
+    def _adopt_predispatch(self, pending: Sequence[Individual]):
+        """Return ``(by_id, dup_map)`` if an earlier :meth:`predispatch`
+        covers exactly this sweep's pending set; else cancel it and return
+        ``None``.  Coverage is checked by object identity — any mutation
+        of the population between breed-ahead and evaluate() (caller
+        edits, partial retry passes) safely voids the pre-dispatch."""
+        pre = getattr(self, "_pre", None)
+        self._pre = None
+        if pre is None:
+            return None
+        by_id, dup_map = pre
+        covered = {id(ind) for ind in by_id.values()}
+        for dups in dup_map.values():
+            covered.update(id(d) for d in dups)
+        if covered == {id(ind) for ind in pending}:
+            return by_id, dup_map
+        logger.info("pre-dispatched jobs stale (population changed); cancelling %d", len(by_id))
+        self.broker.cancel(list(by_id))
+        return None
+
+    def _drop_predispatch(self) -> None:
+        """Cancel any outstanding pre-dispatch (nothing pending to adopt it)."""
+        pre = getattr(self, "_pre", None)
+        self._pre = None
+        if pre is not None:
+            self.broker.cancel(list(pre[0]))
 
     def _collect_speculative(self, by_id: Dict[str, Individual], timeout: float) -> None:
         """Best-effort gather of the sweep's speculative jobs into the

@@ -116,6 +116,12 @@ def main(argv=None) -> int:
     ap.add_argument("--n", type=int, default=None, help="subsample the dataset to n examples")
     ap.add_argument("--capacity", type=int, default=1,
                     help="jobs taken at once; >1 trains the batch as one vmapped program")
+    ap.add_argument("--prefetch-depth", type=int, default=None,
+                    help="jobs queued locally BEYOND capacity so the next "
+                         "window is decoded while the current one trains "
+                         "(double buffering).  Default: capacity.  0 restores "
+                         "the serial pre-pipelining loop; clamped to "
+                         "4 x capacity.  See DISTRIBUTED.md 'Pipelined dispatch'.")
     ap.add_argument("--worker-id", default=None)
     ap.add_argument("--n-chips", type=int, default=None,
                     help="override the advertised accelerator chip count "
@@ -152,6 +158,15 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # Validate operator-visible knobs HERE, loudly: GentunClient clamps
+    # silently (max(1, capacity), prefetch into [0, 4*capacity]) because a
+    # library caller may compute them, but a typed-out `--capacity 0` is a
+    # mistake the operator should hear about, not a worker that quietly
+    # runs with different numbers than its command line says.
+    if args.capacity <= 0:
+        raise SystemExit(f"--capacity must be a positive integer, got {args.capacity}")
+    if args.prefetch_depth is not None and args.prefetch_depth < 0:
+        raise SystemExit(f"--prefetch-depth must be >= 0, got {args.prefetch_depth}")
     if args.telemetry:
         from ..telemetry import spans as tele_spans
 
@@ -197,6 +212,7 @@ def main(argv=None) -> int:
         port=args.port,
         password=args.password,
         capacity=args.capacity,
+        prefetch_depth=args.prefetch_depth,
         worker_id=args.worker_id,
         multihost=multihost,
         n_chips=args.n_chips,

@@ -259,6 +259,19 @@ class Population:
             self._publish_group(group, reps)
         return trained
 
+    def predispatch(self) -> int:
+        """Breed-ahead hook: start this population's fitness work early.
+
+        Local evaluation has nowhere to send work ahead of time, so the
+        base class is a no-op returning 0 — the knob
+        (``GeneticAlgorithm(breed_ahead=True)``) is harmless without a
+        fleet.  ``DistributedPopulation`` overrides this to ship the
+        cache-missed individuals to the broker immediately and lets the
+        next ``evaluate()`` adopt the in-flight jobs (DISTRIBUTED.md
+        "Pipelined dispatch").
+        """
+        return 0
+
     def _train_group(self, batch: List[Individual], reps: List[Individual]) -> bool:
         """Train one parameter-group: batched if the species supports it,
         else the reference's sequential per-individual path.  Returns

@@ -131,32 +131,26 @@ def _file_lock(path: str):
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
-def load_fitness_cache(path: str) -> Dict[Any, float]:
-    """Fitness cache from ``path`` (empty dict when the file doesn't exist).
+def _read_store(path: str):
+    """ONE read of the store file → ``(version, cache)``.
 
-    The returned dict is a plain ``fitness_cache`` for any Population.
-    A corrupt or schema-mismatched file degrades to an empty cache with a
-    loud warning (the original is preserved as ``<path>.corrupt``) — per
-    this module's convention, a cache must NEVER crash a search, least of
-    all at the end-of-run save that would lose the measurements.
+    The shared parse for load and save — the save path used to probe the
+    version with its own ``json.load`` and then call the loader, parsing
+    the file twice inside the same lock.  Missing file → ``(STORE_VERSION,
+    {})``.  A NEWER-versioned file returns its version with an empty cache
+    and is left untouched — callers own the refusal messaging (load warns
+    and ignores, save errors and aborts).  Protocol mismatch warns here
+    (both callers ignore such entries identically); corruption quarantines
+    to ``<path>.corrupt`` and reads as version 1, empty.
     """
     if not os.path.exists(path):
-        return {}
+        return STORE_VERSION, {}
     try:
         with open(path) as f:
             payload = json.load(f)
         version = payload.get("version", 1)
         if version > STORE_VERSION:
-            import logging
-
-            logging.getLogger("gentun_tpu").warning(
-                "fitness store %s has file-schema version %s, newer than "
-                "this writer's %s; IGNORING it — upgrade this process "
-                "before sharing the store (see utils/fitness_store.py).  "
-                "The file is left untouched.",
-                path, version, STORE_VERSION,
-            )
-            return {}
+            return version, {}
         proto = payload.get("protocol", 1)
         if proto != FITNESS_PROTOCOL:
             import logging
@@ -169,8 +163,8 @@ def load_fitness_cache(path: str) -> Dict[Any, float]:
                 "the next save rewrites it at the current protocol.",
                 path, proto, FITNESS_PROTOCOL,
             )
-            return {}
-        return {tuplify(k): float(v) for k, v in payload["entries"]}
+            return version, {}
+        return version, {tuplify(k): float(v) for k, v in payload["entries"]}
     except (ValueError, KeyError, TypeError, AttributeError) as e:
         backup = path + ".corrupt"
         try:
@@ -183,7 +177,31 @@ def load_fitness_cache(path: str) -> Dict[Any, float]:
             "fitness store %s is unreadable (%s); starting empty, original "
             "kept at %s", path, e, backup,
         )
+        return 1, {}
+
+
+def load_fitness_cache(path: str) -> Dict[Any, float]:
+    """Fitness cache from ``path`` (empty dict when the file doesn't exist).
+
+    The returned dict is a plain ``fitness_cache`` for any Population.
+    A corrupt or schema-mismatched file degrades to an empty cache with a
+    loud warning (the original is preserved as ``<path>.corrupt``) — per
+    this module's convention, a cache must NEVER crash a search, least of
+    all at the end-of-run save that would lose the measurements.
+    """
+    version, cache = _read_store(path)
+    if version > STORE_VERSION:
+        import logging
+
+        logging.getLogger("gentun_tpu").warning(
+            "fitness store %s has file-schema version %s, newer than "
+            "this writer's %s; IGNORING it — upgrade this process "
+            "before sharing the store (see utils/fitness_store.py).  "
+            "The file is left untouched.",
+            path, version, STORE_VERSION,
+        )
         return {}
+    return cache
 
 
 def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
@@ -201,24 +219,19 @@ def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
         # A newer-versioned file must not be rewritten: our loader reads it
         # as empty, so the merge below would atomically replace it with only
         # this process's entries — destroying the newer fleet's measurements.
-        if os.path.exists(path):
-            try:
-                with open(path) as f:
-                    existing_version = json.load(f).get("version", 1)
-            except (ValueError, AttributeError):
-                existing_version = 1  # corrupt: load() quarantines it below
-            if existing_version > STORE_VERSION:
-                import logging
+        # ONE read answers both the version guard and the merge base.
+        existing_version, merged = _read_store(path)
+        if existing_version > STORE_VERSION:
+            import logging
 
-                logging.getLogger("gentun_tpu").error(
-                    "REFUSING to save fitness store %s: its file-schema "
-                    "version %s is newer than this writer's %s.  Upgrade "
-                    "this process, or point it at a different store file; "
-                    "these measurements were NOT persisted.",
-                    path, existing_version, STORE_VERSION,
-                )
-                return 0
-        merged = load_fitness_cache(path)
+            logging.getLogger("gentun_tpu").error(
+                "REFUSING to save fitness store %s: its file-schema "
+                "version %s is newer than this writer's %s.  Upgrade "
+                "this process, or point it at a different store file; "
+                "these measurements were NOT persisted.",
+                path, existing_version, STORE_VERSION,
+            )
+            return 0
         for k, v in cache.items():
             if not is_serializable_key(k):
                 continue

@@ -24,6 +24,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from gentun_tpu import Individual, genetic_cnn_genome  # noqa: E402
 from gentun_tpu.distributed import GentunClient, JobBroker  # noqa: E402
+from gentun_tpu.telemetry import spans as spans_mod  # noqa: E402
+from gentun_tpu.telemetry.registry import get_registry  # noqa: E402
 
 
 class NoopIndividual(Individual):
@@ -47,6 +49,14 @@ def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
         }
         for i in range(n_jobs)
     }
+    # Telemetry on for the duration: the broker stamps each dispatch and
+    # observes the result round trip into the ``dispatch_rtt_s`` histogram,
+    # so the benchmark reports per-job control-plane latency percentiles
+    # alongside aggregate throughput.  Under the default worker prefetch
+    # the RTT includes local-queue residence on the worker — it measures
+    # the full dispatch→result pipeline, not socket latency alone.
+    get_registry().reset()
+    spans_mod.enable()
     broker = JobBroker(port=0).start()
     stop = threading.Event()
     threads = []
@@ -67,6 +77,7 @@ def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
         results = broker.gather(list(payloads), timeout=120.0)
         wall = time.monotonic() - t0
         assert len(results) == n_jobs
+        rtt = get_registry().histogram("dispatch_rtt_s")
         return {
             "n_jobs": n_jobs,
             "n_workers": n_workers,
@@ -75,10 +86,17 @@ def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
             "jobs_per_sec": round(n_jobs / wall, 1),
             # one chip consumes ~6.2 proxy jobs/sec (bench.py ≈22.2k/hour)
             "chips_fed_at_proxy_rate": int(n_jobs / wall / 6.2),
+            "dispatch_rtt_s": {
+                "count": rtt.count,
+                "p50": round(rtt.quantile(0.50), 6),
+                "p90": round(rtt.quantile(0.90), 6),
+                "p99": round(rtt.quantile(0.99), 6),
+            },
         }
     finally:
         stop.set()
         broker.stop()
+        spans_mod.disable()
 
 
 if __name__ == "__main__":

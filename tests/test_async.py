@@ -235,7 +235,10 @@ class TestDistributedInFlight:
             sampler.start()
             best = eng.run(max_evaluations=12)
             assert eng.completed == 12
-            assert eng._cap == 2  # resolved from fleet capacity
+            # Resolved from the fleet's dispatch WINDOW: 2 × (capacity 1 +
+            # default prefetch_depth = capacity), the breed-ahead target of
+            # the pipelined dispatch plane.
+            assert eng._cap == 4
             assert best.get_fitness() == max(
                 h["fitness"] for h in eng.history if h["fitness"] is not None)
             # The fleet was actually saturated, not trickle-fed.

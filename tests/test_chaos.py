@@ -65,11 +65,18 @@ def _free_port() -> int:
 
 def _start_worker(port, injector=None, worker_id=None, capacity=1):
     """Worker thread with chaos-friendly timings (fast heartbeat, fast
-    reconnect with a tight cap so injected drops cost milliseconds)."""
+    reconnect with a tight cap so injected drops cost milliseconds).
+
+    prefetch_depth=0 pins the serial consume loop: this module's fault
+    schedules count frames/evaluations against the historical dispatch
+    pattern (e.g. the E2E's fail_eval lands on worker 0's third
+    evaluation), and over-subscription redistributes work between the
+    faulted and clean workers.  Prefetch-composed chaos has its own
+    coverage in tests/test_pipeline.py."""
     stop = threading.Event()
     client = GentunClient(
         OneMax, *DATA, host="127.0.0.1", port=port,
-        capacity=capacity, worker_id=worker_id,
+        capacity=capacity, prefetch_depth=0, worker_id=worker_id,
         heartbeat_interval=0.2, reconnect_delay=0.05, reconnect_max_delay=0.5,
         fault_injector=injector,
     )

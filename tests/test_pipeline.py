@@ -1,0 +1,251 @@
+"""Pipelined dispatch plane: back-compat, chaos composition, breed-ahead.
+
+The double-buffered worker loop and broker over-subscription
+(DISTRIBUTED.md "Pipelined dispatch") are versioned by an OPTIONAL hello
+field, so four deployments must all complete the same seeded search with
+identical results:
+
+- new worker ↔ new broker (the default, exercised everywhere else),
+- OLD-frame worker (no ``prefetch_depth`` key at all) ↔ new broker,
+- new worker ↔ old broker (one that ignores the field),
+- ``prefetch_depth=0`` worker ↔ new broker (the serial loop, bit-identical
+  to the pre-pipelining flow — pinned by tests/test_chaos.py).
+
+Identity is checked against a LOCAL clean run: the generational trajectory
+is completion-order independent (barrier + pure fitness + cache), so any
+dispatch interleaving must land on the same history.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import DistributedPopulation, GentunClient
+from gentun_tpu.distributed.faults import FaultInjector, FaultPlan, FaultSpec
+from gentun_tpu.distributed.worker import main as worker_main
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+GENERATIONS = 3
+
+
+class LegacyFrameClient(GentunClient):
+    """A pre-pipelining worker on today's code: its hello frame carries NO
+    ``prefetch_depth`` key (not even 0), exactly what an old binary sends."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["prefetch_depth"] = 0  # old workers also consume serially
+        super().__init__(*args, **kwargs)
+
+    def _send(self, msg):
+        if msg.get("type") == "hello":
+            msg = {k: v for k, v in msg.items() if k != "prefetch_depth"}
+        super()._send(msg)
+
+
+def _clean_history():
+    ga = GeneticAlgorithm(Population(OneMax, *DATA, size=6, seed=42), seed=7)
+    ga.run(GENERATIONS)
+    return ga
+
+
+def _start_client(client, stop):
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return t
+
+
+def _distributed_history(worker_factory, n_workers=2, breed_ahead=False):
+    """Seeded 2-worker search; returns the finished GA for comparison."""
+    pop = DistributedPopulation(OneMax, size=6, seed=42, port=0, job_timeout=60)
+    stops, ga = [], None
+    try:
+        _, port = pop.broker_address
+        for i in range(n_workers):
+            stop = threading.Event()
+            _start_client(worker_factory(port, i), stop)
+            stops.append(stop)
+        ga = GeneticAlgorithm(pop, seed=7, breed_ahead=breed_ahead)
+        ga.run(GENERATIONS)
+        return ga
+    finally:
+        for stop in stops:
+            stop.set()
+        pop.close()
+        if ga is not None:
+            ga.population.close()
+
+
+def _assert_same_trajectory(ga, clean):
+    assert [r["best_fitness"] for r in ga.history] == \
+           [r["best_fitness"] for r in clean.history]
+    assert [(i.get_genes(), i.get_fitness()) for i in ga.population] == \
+           [(i.get_genes(), i.get_fitness()) for i in clean.population]
+
+
+class TestBackCompat:
+    def test_legacy_frame_worker_against_prefetching_broker(self):
+        """Old-frame workers (no prefetch_depth in hello) complete a seeded
+        search against today's broker with identical results — the broker
+        reads the missing field as 0 and serves the historical credit."""
+        clean = _clean_history()
+
+        def factory(port, i):
+            return LegacyFrameClient(
+                OneMax, *DATA, host="127.0.0.1", port=port,
+                capacity=1, worker_id=f"legacy-w{i}",
+                heartbeat_interval=0.2, reconnect_delay=0.1)
+
+        _assert_same_trajectory(_distributed_history(factory), clean)
+
+    def test_new_worker_against_old_broker(self, monkeypatch):
+        """A prefetching worker against a broker that ignores the field
+        (simulated by pinning _parse_prefetch to 0, which is what an old
+        broker's absent parsing amounts to): its over-asking ``ready`` is
+        clamped at capacity, and the search completes identically."""
+        from gentun_tpu.distributed.broker import JobBroker
+
+        monkeypatch.setattr(JobBroker, "_parse_prefetch",
+                            staticmethod(lambda hello, capacity: 0))
+        clean = _clean_history()
+
+        def factory(port, i):
+            return GentunClient(  # default prefetch_depth = capacity
+                OneMax, *DATA, host="127.0.0.1", port=port,
+                capacity=1, worker_id=f"new-w{i}",
+                heartbeat_interval=0.2, reconnect_delay=0.1)
+
+        _assert_same_trajectory(_distributed_history(factory), clean)
+
+    def test_prefetching_fleet_matches_clean_run(self):
+        """The new default end to end: both sides pipelined, same results."""
+        clean = _clean_history()
+
+        def factory(port, i):
+            return GentunClient(
+                OneMax, *DATA, host="127.0.0.1", port=port,
+                capacity=1, worker_id=f"pipe-w{i}",
+                heartbeat_interval=0.2, reconnect_delay=0.1)
+
+        _assert_same_trajectory(_distributed_history(factory), clean)
+
+
+class TestChaosComposition:
+    def test_disconnect_requeues_queued_but_unstarted_jobs(self):
+        """A prefetching worker that drops its connection mid-window holds
+        decoded-but-unstarted jobs in its local queue; the broker's
+        requeue-on-disconnect must redeliver THOSE too (they are in
+        ``in_flight`` — dispatched, unacked), or the search hangs."""
+        clean = _clean_history()
+        inj = FaultInjector(FaultPlan([
+            FaultSpec(hook="client_send", kind="drop_connection",
+                      match_type="results", at=0),
+        ]))
+
+        def factory(port, i):
+            return GentunClient(
+                OneMax, *DATA, host="127.0.0.1", port=port,
+                capacity=1, worker_id=f"chaos-pipe-w{i}",
+                heartbeat_interval=0.2, reconnect_delay=0.05,
+                reconnect_max_delay=0.5,
+                fault_injector=inj if i == 0 else None)
+
+        ga = _distributed_history(factory)
+        _assert_same_trajectory(ga, clean)
+        assert any(f["kind"] == "drop_connection" for f in inj.fired)
+
+
+class TestBreedAhead:
+    def test_breed_ahead_trajectory_identical(self):
+        """breed_ahead=True pre-dispatches each bred generation; fitness
+        purity + the barrier make the trajectory identical either way."""
+        clean = _clean_history()
+
+        def factory(port, i):
+            return GentunClient(
+                OneMax, *DATA, host="127.0.0.1", port=port,
+                capacity=1, worker_id=f"ahead-w{i}",
+                heartbeat_interval=0.2, reconnect_delay=0.1)
+
+        ga = _distributed_history(factory, breed_ahead=True)
+        _assert_same_trajectory(ga, clean)
+
+    def test_breed_ahead_off_is_default_and_checkpointed(self):
+        ga = GeneticAlgorithm(Population(OneMax, *DATA, size=4, seed=0), seed=0)
+        assert ga.breed_ahead is False
+        state = ga.state_dict()
+        assert state["breed_ahead"] is False
+        ga2 = GeneticAlgorithm(
+            Population(OneMax, *DATA, size=4, seed=0), seed=0, breed_ahead=True)
+        ga2.load_state_dict(state)  # checkpointed value wins over the ctor
+        assert ga2.breed_ahead is False
+        # pre-pipelining checkpoints lack the key: constructor value survives
+        del state["breed_ahead"]
+        ga3 = GeneticAlgorithm(
+            Population(OneMax, *DATA, size=4, seed=0), seed=0, breed_ahead=True)
+        ga3.load_state_dict(state)
+        assert ga3.breed_ahead is True
+
+    def test_local_predispatch_is_noop(self):
+        pop = Population(OneMax, *DATA, size=3, seed=1)
+        assert pop.predispatch() == 0
+        ga = GeneticAlgorithm(pop, seed=1, breed_ahead=True)  # harmless locally
+        ga.run(1)
+
+    def test_stale_predispatch_cancelled_and_rebuilt(self):
+        """Mutating the population between breed-ahead and evaluate voids
+        the pre-dispatch: the stale jobs are cancelled and evaluate()
+        ships the real pending set."""
+        pop = DistributedPopulation(OneMax, size=4, seed=3, port=0, job_timeout=60)
+        stop = threading.Event()
+        try:
+            _, port = pop.broker_address
+            client = GentunClient(OneMax, *DATA, host="127.0.0.1", port=port,
+                                  capacity=1, heartbeat_interval=0.2,
+                                  reconnect_delay=0.1)
+            _start_client(client, stop)
+            assert pop.predispatch() > 0
+            # swap one individual: the pre-dispatched cohort no longer
+            # covers the pending set
+            pop.individuals[0] = pop.spawn()
+            pop.evaluate()
+            assert all(i.fitness_evaluated for i in pop)
+            # cancelled stale jobs must leave zero broker state behind
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and any(pop.broker.outstanding().values()):
+                time.sleep(0.05)
+            assert not any(pop.broker.outstanding().values())
+        finally:
+            stop.set()
+            pop.close()
+
+
+class TestWorkerCLIValidation:
+    def test_capacity_zero_is_loud_exit(self):
+        with pytest.raises(SystemExit, match="capacity"):
+            worker_main(["--capacity", "0", "--dataset", "uci-wine"])
+
+    def test_capacity_negative_is_loud_exit(self):
+        with pytest.raises(SystemExit, match="capacity"):
+            worker_main(["--capacity", "-3", "--dataset", "uci-wine"])
+
+    def test_negative_prefetch_is_loud_exit(self):
+        with pytest.raises(SystemExit, match="prefetch"):
+            worker_main(["--prefetch-depth", "-1", "--dataset", "uci-wine"])
+
+    def test_client_still_clamps_for_library_callers(self):
+        # The CLI is loud; the library keeps its documented lenient clamp.
+        c = GentunClient(OneMax, *DATA, capacity=0, prefetch_depth=99)
+        assert c.capacity == 1
+        assert c.prefetch_depth == 4  # 4 × capacity cap

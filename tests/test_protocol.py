@@ -11,6 +11,7 @@ import json
 
 import pytest
 
+from gentun_tpu.distributed.broker import JobBroker
 from gentun_tpu.distributed.protocol import (
     MAX_MESSAGE_BYTES,
     ProtocolError,
@@ -102,3 +103,37 @@ class TestCoalesceResults:
     def test_empty_entries_yield_no_frames(self):
         assert coalesce_results([]) == []
         assert coalesce_results([], spans=[{"kind": "eval"}]) == []
+
+
+class TestPrefetchField:
+    """The pipelined-dispatch hello field: optional, conservative default,
+    clamped — old frames and garbage both degrade to the un-pipelined
+    flow instead of erroring (the protocol's versioning convention)."""
+
+    def test_hello_without_prefetch_round_trips(self):
+        # The old-worker frame: no prefetch_depth key at all.
+        msg = {"type": "hello", "worker_id": "w0", "token": None, "capacity": 4}
+        assert decode(encode(msg)) == msg
+        assert JobBroker._parse_prefetch(msg, 4) == 0
+
+    def test_hello_with_prefetch_round_trips(self):
+        msg = {"type": "hello", "worker_id": "w0", "capacity": 4, "prefetch_depth": 4}
+        assert decode(encode(msg)) == msg
+        assert JobBroker._parse_prefetch(msg, 4) == 4
+
+    def test_prefetch_clamped_to_four_times_capacity(self):
+        assert JobBroker._parse_prefetch({"prefetch_depth": 1000}, 2) == 8
+        assert JobBroker._parse_prefetch({"prefetch_depth": 8}, 2) == 8
+
+    def test_negative_prefetch_clamped_to_zero(self):
+        assert JobBroker._parse_prefetch({"prefetch_depth": -3}, 2) == 0
+
+    def test_malformed_prefetch_degrades_to_zero(self):
+        # A broken or hostile field must not tear down the handshake:
+        # unparsable values mean "no prefetch", exactly like absence.
+        for bad in ("lots", None, [2], {"n": 2}):
+            assert JobBroker._parse_prefetch({"prefetch_depth": bad}, 2) == 0
+
+    def test_numeric_string_prefetch_accepted(self):
+        # int() coercion keeps jsons from sloppy encoders working.
+        assert JobBroker._parse_prefetch({"prefetch_depth": "3"}, 4) == 3

@@ -131,6 +131,35 @@ class TestFitnessStore:
         assert payload["protocol"] == FITNESS_PROTOCOL
         assert load_fitness_cache(path) == {("b",): 1.0}
 
+    def test_newer_version_store_refused_untouched(self, tmp_path, caplog):
+        """Mixed-version fleets: a file stamped with a NEWER schema version
+        must be ignored on load (warning) and REFUSED on save (error, zero
+        persisted) — an older writer's read-merge-write would load it as
+        empty and clobber the newer fleet's measurements.  Either way the
+        file's bytes stay exactly as they were."""
+        import json
+        import logging
+
+        from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
+        from gentun_tpu.utils.fitness_store import STORE_VERSION
+
+        path = tmp_path / "fit.json"
+        future = json.dumps({
+            "version": STORE_VERSION + 1,
+            "protocol": 99,
+            "entries": [[["future-key"], 0.99]],
+        })
+        path.write_text(future)
+        with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+            assert load_fitness_cache(str(path)) == {}
+        assert "newer" in caplog.text
+        assert not (tmp_path / "fit.json.corrupt").exists()  # not corruption
+        caplog.clear()
+        with caplog.at_level(logging.ERROR, logger="gentun_tpu"):
+            assert save_fitness_cache({("mine",): 1.0}, str(path)) == 0
+        assert "REFUSING" in caplog.text
+        assert path.read_text() == future  # byte-for-byte untouched
+
     def test_unserializable_keys_skipped(self, tmp_path):
         from gentun_tpu.utils import load_fitness_cache, save_fitness_cache
 
