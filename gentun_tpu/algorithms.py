@@ -26,6 +26,7 @@ import numpy as np
 
 from .individuals import Individual
 from .populations import Population
+from .telemetry import health as _health
 from .telemetry import spans as _tele
 from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
 
@@ -131,6 +132,11 @@ class GeneticAlgorithm:
         payloads are built, so its context is what rides the wire to
         workers (``DistributedPopulation._evaluate_once``).
         """
+        # Advisory heartbeat for /statusz (one bool read when the ops plane
+        # is off): a generation legitimately takes unbounded time, so this
+        # never gates /healthz — it tells an operator when the engine last
+        # crossed a generation boundary.
+        _health.beat("engine_loop")
         with _tele.span("generation", {"generation": self.generation}):
             t0 = time.monotonic()
             # Count only the individuals actually trained this step (cached
@@ -200,16 +206,41 @@ class GeneticAlgorithm:
         )
         # One root span per run → one trace_id stitching every generation
         # (and, via payload propagation, every worker span) together.
-        with _tele.span("run", {"generations": max(remaining, 0)}):
-            for _ in range(max(remaining, 0)):
-                self.evolve_population()
-            # The final offspring still need fitness; give the pass its own
-            # evaluate span so its worker spans parent consistently.
-            with _tele.span("evaluate"):
-                self.population.evaluate()
-                best = self.population.get_fittest()
+        _health.register_status_provider("engine", self._ops_status)
+        try:
+            with _tele.span("run", {"generations": max(remaining, 0)}) as run_span:
+                # /statusz "active trace_id": the no-op span has no
+                # trace_id attribute, so this stays None when disabled.
+                self._run_trace_id = getattr(run_span, "trace_id", None)
+                for _ in range(max(remaining, 0)):
+                    self.evolve_population()
+                # The final offspring still need fitness; give the pass its
+                # own evaluate span so its worker spans parent consistently.
+                with _tele.span("evaluate"):
+                    self.population.evaluate()
+                    best = self.population.get_fittest()
+        finally:
+            _health.unregister_status_provider("engine", self._ops_status)
         logger.info("search done: best fitness %.6g, genes %s", best.get_fitness(), best.get_genes())
         return best
+
+    def _ops_status(self) -> Dict[str, Any]:
+        """The ``/statusz`` "engine" block (``telemetry/health.py`` status
+        provider, polled from HTTP threads — snapshot reads only)."""
+        # Ever-best across the whole run, not just the last generation —
+        # without elitism a generation's best can regress.
+        fits = [h["best_fitness"] for h in self.history
+                if h.get("best_fitness") is not None]
+        best = None
+        if fits:
+            best = max(fits) if self.population.maximize else min(fits)
+        return {
+            "mode": "generational",
+            "generation": self.generation,
+            "population_size": len(self.population),
+            "best_fitness": best,
+            "trace_id": getattr(self, "_run_trace_id", None),
+        }
 
     # -- logging -----------------------------------------------------------
 

@@ -50,6 +50,7 @@ import numpy as np
 
 from .individuals import Individual
 from .populations import Population
+from .telemetry import health as _health
 from .telemetry import spans as _tele
 from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
 
@@ -314,11 +315,19 @@ class AsyncEvolution:
             "starting AsyncEvolution: ring=%d, budget=%d (%d done), in-flight target=%d",
             self.pop_size, budget, self.completed, self._cap,
         )
+        _health.register_status_provider("engine", self._ops_status)
         with _tele.span("run", {"mode": "async", "budget": budget,
-                                "max_in_flight": self._cap}):
+                                "max_in_flight": self._cap}) as run_span:
+            # /statusz "active trace_id" (None while telemetry is off —
+            # the no-op span has no trace_id attribute).
+            self._run_trace_id = getattr(run_span, "trace_id", None)
             try:
                 self._refill(evaluator, budget)
                 while self.completed < budget and (self._inflight or self._queue):
+                    # Advisory /statusz beat: one bool read when the ops
+                    # plane is off.  Never gates /healthz — a wake-up can
+                    # legitimately be an evaluation-time apart.
+                    _health.beat("engine_loop")
                     events = evaluator.wait_any(self.job_timeout)
                     if not events:
                         raise TimeoutError(
@@ -330,6 +339,7 @@ class AsyncEvolution:
                     self._refill(evaluator, budget)
                     self._boundary()
             finally:
+                _health.unregister_status_provider("engine", self._ops_status)
                 leftover = list(self._inflight)
                 if leftover:
                     # Budget reached with children still training: their
@@ -348,6 +358,22 @@ class AsyncEvolution:
             self.completed, self.best.get_fitness(), self.best.get_genes(),
         )
         return self.best
+
+    def _ops_status(self) -> Dict[str, Any]:
+        """The ``/statusz`` "engine" block while an async search runs
+        (``telemetry/health.py`` status provider; snapshot reads only —
+        ``self.best`` is replaced wholesale, never mutated in place)."""
+        best = self.best
+        return {
+            "mode": "async",
+            "completed": self.completed,
+            "dispatched": self.dispatched,
+            "in_flight": len(self._inflight),
+            "queued": len(self._queue),
+            "ring_size": self.pop_size,
+            "best_fitness": best.get_fitness() if best is not None else None,
+            "trace_id": getattr(self, "_run_trace_id", None),
+        }
 
     # -- internals ---------------------------------------------------------
 

@@ -46,6 +46,7 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
+from ..telemetry import health as _health
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
@@ -145,6 +146,17 @@ class JobBroker:
         Optional :class:`distributed.faults.FaultInjector` for deterministic
         chaos testing.  ``None`` (the default) costs one attribute check per
         frame and nothing else.
+    straggler_floor_s, straggler_k:
+        Stall-watchdog tuning (``telemetry/health.py``): a dispatched job is
+        flagged as a straggler after ``max(floor, k × rolling-p95(RTT))``
+        seconds in flight.  Only consulted while the ops plane is enabled
+        (``telemetry.start_ops_server``); otherwise the watchdog sees no
+        traffic at all.
+    straggler_requeue:
+        Opt-in: a flagged straggler is pulled from its worker and requeued
+        for redelivery (the membership dedup drops the stalled worker's
+        late result, exactly like disconnect redelivery).  Off by default —
+        flagging alone never changes the dispatch schedule.
     """
 
     def __init__(
@@ -155,6 +167,9 @@ class JobBroker:
         heartbeat_timeout: float = 15.0,
         max_attempts: int = 3,
         fault_injector=None,
+        straggler_floor_s: float = 30.0,
+        straggler_k: float = 4.0,
+        straggler_requeue: bool = False,
     ):
         self._host = host
         self._port = port
@@ -162,11 +177,23 @@ class JobBroker:
         self._heartbeat_timeout = float(heartbeat_timeout)
         self._max_attempts = int(max_attempts)
         self._injector = fault_injector
+        # Ops plane (telemetry/health.py): the watchdog is fed from the
+        # loop thread behind `_health.enabled()` gates, checked by
+        # _watchdog_loop.  Check cadence adapts to the floor so a test
+        # with a sub-second floor is flagged promptly, without busy-spin.
+        self._watchdog_interval = max(0.05, min(1.0, float(straggler_floor_s) / 4.0))
+        self._straggler_requeue = bool(straggler_requeue)
+        self._watchdog = _health.StallWatchdog(
+            floor_s=straggler_floor_s,
+            k=straggler_k,
+            on_straggler=self._on_straggler if straggler_requeue else None,
+        )
 
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._reaper_task: Optional[asyncio.Task] = None
+        self._watchdog_task: Optional[asyncio.Task] = None
         self._started = threading.Event()
         self._stopping = False
 
@@ -215,6 +242,13 @@ class JobBroker:
         self._thread.start()
         if not self._started.wait(timeout=10.0):
             raise RuntimeError("broker failed to start within 10s")
+        # Ops-plane registration: dict writes, harmless while the plane is
+        # disabled.  The loop's beat gates /healthz — a wedged broker loop
+        # goes stale within a few watchdog intervals.
+        _health.register_source(
+            "broker_loop", timeout=max(2.0, 10.0 * self._watchdog_interval))
+        _health.register_watchdog(self._watchdog)
+        _health.register_status_provider("fleet", self._ops_status)
         return self
 
     def stop(self) -> None:
@@ -257,6 +291,10 @@ class JobBroker:
         self._thread = None
         self._loop = None
         self._started.clear()
+        _health.unregister_watchdog(self._watchdog)
+        _health.unregister_status_provider("fleet", self._ops_status)
+        _health.unregister_source("broker_loop")
+        self._watchdog.clear()
 
     def _run_loop(self) -> None:
         loop = asyncio.new_event_loop()
@@ -278,6 +316,7 @@ class JobBroker:
         sock = self._server.sockets[0]
         self._bound = sock.getsockname()[:2]
         self._reaper_task = asyncio.ensure_future(self._reaper())
+        self._watchdog_task = asyncio.ensure_future(self._watchdog_loop())
         self._started.set()
         logger.info("broker listening on %s:%d", *self._bound)
 
@@ -453,10 +492,13 @@ class JobBroker:
             return
 
         def _do():
+            ops = _health.enabled()
             for j in ids:
                 self._payloads.pop(j, None)
                 self._tele_enqueued.pop(j, None)
                 self._tele_dispatched.pop(j, None)
+                if ops:
+                    self._watchdog.job_removed(j)
             if any(j in ids for j in self._pending):
                 # Drain cancelled ids now: with no worker connected nothing
                 # else pops the deque, and a retry loop would grow it by one
@@ -610,6 +652,7 @@ class JobBroker:
         if not self._pending:
             return
         tele = _tele.enabled()
+        ops = _health.enabled()
         for w in list(self._workers.values()):
             batch: List[Dict[str, Any]] = []
             batch_bytes = 0
@@ -642,6 +685,10 @@ class JobBroker:
                         _get_registry().histogram("queue_wait_s").observe(wait)
                     # dispatch_rtt_s starts here: handoff to the worker.
                     self._tele_dispatched[job_id] = time.monotonic()
+                if ops:
+                    # Same clock start as dispatch_rtt_s: the watchdog
+                    # measures handoff → now against its rolling threshold.
+                    self._watchdog.job_started(job_id, w.worker_id)
                 entry = {"job_id": job_id, **self._payloads[job_id]}
                 entry_bytes = len(encode(entry))
                 if batch and batch_bytes + entry_bytes > soft_cap:
@@ -666,7 +713,10 @@ class JobBroker:
 
     def _requeue_worker_jobs(self, w: _Worker, reason: str) -> None:
         tele = _tele.enabled()
+        ops = _health.enabled()
         for job_id in sorted(w.in_flight):
+            if ops:
+                self._watchdog.job_removed(job_id)
             if job_id in self._payloads:
                 logger.warning("requeue job %s (%s, worker %s)", job_id, reason, w.worker_id)
                 # Disconnect redelivery is unbounded, like AMQP's.  This
@@ -693,6 +743,85 @@ class JobBroker:
                 if w.in_flight and now - w.last_seen > self._heartbeat_timeout:
                     logger.warning("worker %s missed heartbeats; dropping", w.worker_id)
                     w.writer.close()  # triggers cleanup in _handle_worker
+
+    async def _watchdog_loop(self) -> None:
+        """Beat the broker's liveness source and sweep for stragglers.
+
+        Separate from :meth:`_reaper` because the cadences differ by an
+        order of magnitude: the reaper runs at heartbeat scale (seconds to
+        tens of seconds), the watchdog must flag within a fraction of its
+        floor.  While the ops plane is off each pass is one bool read and
+        a sleep.
+        """
+        while not self._stopping:
+            await asyncio.sleep(self._watchdog_interval)
+            if _health.enabled():
+                _health.beat("broker_loop")
+                self._watchdog.check()
+
+    def _on_straggler(self, info: Dict[str, Any]) -> None:
+        """Watchdog requeue hook (``straggler_requeue=True``).  May fire
+        from the loop thread (watchdog sweep) or an HTTP handler thread
+        (healthz-triggered check); the mutation hops to the loop thread
+        either way — broker state stays single-threaded."""
+        loop = self._loop
+        if loop is not None:
+            loop.call_soon_threadsafe(self._requeue_straggler, info)
+
+    def _requeue_straggler(self, info: Dict[str, Any]) -> None:
+        job_id = str(info.get("job_id"))
+        if job_id not in self._payloads or job_id in self._pending:
+            return  # finished/cancelled/already requeued since flagging
+        holder = next((w for w in self._workers.values() if job_id in w.in_flight), None)
+        if holder is None:
+            return  # the worker vanished; disconnect cleanup already requeued
+        logger.warning(
+            "requeue straggler job %s (worker %s, in flight %.1fs > %.1fs threshold)",
+            job_id, holder.worker_id, info.get("age_s", -1.0),
+            info.get("threshold_s", -1.0))
+        # The stalled worker's credit stays consumed: it is not accepting
+        # new work anyway, and its late result is dropped by the payload
+        # membership check like any redelivery duplicate.
+        holder.in_flight.discard(job_id)
+        self._pending.append(job_id)
+        self._watchdog.job_removed(job_id)
+        self._tele_dispatched.pop(job_id, None)
+        if _tele.enabled():
+            self._tele_enqueued[job_id] = time.monotonic()
+        _get_registry().counter(
+            "stragglers_requeued_total", worker=holder.worker_id).inc()
+        _tele.record_event("straggler_requeued", {
+            "job_id": job_id, "worker_id": holder.worker_id,
+            "age_s": info.get("age_s"), "threshold_s": info.get("threshold_s"),
+        })
+        self._dispatch()
+
+    def _ops_status(self) -> Dict[str, Any]:
+        """The ``/statusz`` "fleet" block (registered as a status
+        provider).  Snapshot reads from an HTTP thread, same discipline as
+        :meth:`fleet_capacity`: list() the worker table, read scalars —
+        never mutate."""
+        now = time.monotonic()
+        workers = [{
+            "worker_id": w.worker_id,
+            "capacity": w.capacity,
+            "prefetch_depth": w.prefetch_depth,
+            "credit": w.credit,
+            "jobs_in_flight": len(w.in_flight),
+            "last_seen_age_s": round(now - w.last_seen, 3),
+            "n_chips": w.n_chips,
+            "backend": w.backend,
+        } for w in list(self._workers.values())]
+        return {
+            "address": list(self._bound) if self._started.is_set() else None,
+            "workers": workers,
+            "queue_depth": len(self._pending),
+            "open_jobs": len(self._payloads),
+            "jobs_in_flight": sum(x["jobs_in_flight"] for x in workers),
+            "straggler_threshold_s": round(self._watchdog.threshold(), 3),
+            "stragglers": self._watchdog.stragglers(),
+            "straggler_requeue": self._straggler_requeue,
+        }
 
     async def _handle_worker(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         wid = next(self._worker_seq)
@@ -837,6 +966,10 @@ class JobBroker:
             return False
         payload = self._payloads[job_id]
         del self._payloads[job_id]
+        if _health.enabled():
+            # Fresh results only (behind the dedup check): a duplicate's
+            # RTT would double-sample the watchdog's rolling window.
+            self._watchdog.job_finished(job_id)
         if _tele.enabled():
             # Behind the membership check on purpose: a duplicated result
             # frame (chaos: duplicate_result) must not double-ingest the
@@ -879,6 +1012,9 @@ class JobBroker:
         w.in_flight.discard(job_id)
         if job_id not in self._payloads:
             return
+        if _health.enabled():
+            # Fail is not a round trip: forget without sampling the RTT.
+            self._watchdog.job_removed(job_id)
         # Only explicit worker-side failures count toward max_attempts;
         # disconnect/reaper redeliveries are unbounded, like AMQP's.
         self._fail_counts[job_id] = self._fail_counts.get(job_id, 0) + 1

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Type
 
 from ..individuals import Individual
 from ..populations import Population
+from ..telemetry import health as _health
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .protocol import (
@@ -336,6 +337,11 @@ class GentunClient:
                 self._raw_send(encode({"type": "ping"}))
             except Exception:
                 pass  # main loop will notice and reconnect
+            else:
+                # Beat only on a DELIVERED ping: an injected hang (above)
+                # or dead socket leaves this worker's /healthz stale, the
+                # same silence the broker's reaper sees.
+                _health.beat("worker_heartbeat")
 
     # -- the consume loop --------------------------------------------------
 
@@ -357,6 +363,14 @@ class GentunClient:
         stop = stop_event or threading.Event()
         self._stop = threading.Event()
         self._jobs_done = 0  # each work() call gets a fresh budget
+        # Ops-plane registration (dict writes, inert while the plane is
+        # off): the ping thread's beat gates this process's /healthz — it
+        # pings even during a long jitted train step, so only a genuinely
+        # hung or disconnected worker goes stale.  The consume/evaluate
+        # beats are advisory (a long compile legitimately silences them).
+        _health.register_source(
+            "worker_heartbeat", timeout=max(5.0, 4.0 * self.heartbeat_interval))
+        _health.register_status_provider("worker", self._ops_status)
         hb = threading.Thread(target=self._heartbeat_loop, name="gentun-heartbeat", daemon=True)
         hb.start()
         backoff = _ReconnectBackoff(self.reconnect_delay, self.reconnect_max_delay, self.worker_id)
@@ -382,9 +396,23 @@ class GentunClient:
         finally:
             self._stop.set()
             self._graceful_close()
+            _health.unregister_status_provider("worker", self._ops_status)
+            _health.unregister_source("worker_heartbeat")
             if self.multihost:
                 self._mh.broadcast_payload(None)  # release the followers
         return self._jobs_done
+
+    def _ops_status(self) -> Dict[str, Any]:
+        """The ``/statusz`` "worker" block when the ops plane runs inside
+        a worker process (``--ops-port``)."""
+        return {
+            "worker_id": self.worker_id,
+            "capacity": self.capacity,
+            "prefetch_depth": self.prefetch_depth,
+            "jobs_done": self._jobs_done,
+            "connected": self._handshaken.is_set(),
+            "multihost": self.multihost,
+        }
 
     def _work_follower(self) -> int:
         """Non-leader ranks: evaluate what the leader broadcasts, reply never.
@@ -425,6 +453,7 @@ class GentunClient:
         one — the bit-identity anchor for determinism and chaos tests.
         """
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
+            _health.beat("worker_consume")
             self._send({"type": "ready", "credit": self.capacity})
             # The broker delivers everything our credit allows as ONE `jobs`
             # frame (credit-based prefetch), so a capacity-N worker receives
@@ -494,6 +523,7 @@ class GentunClient:
         # and the thread dies with it — no separate stop signal needed.
         self._send({"type": "ready", "credit": self.capacity + self.prefetch_depth})
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
+            _health.beat("worker_consume")
             try:
                 item = ready_q.get(timeout=0.25)
             except _queue.Empty:
@@ -532,6 +562,7 @@ class GentunClient:
         # exists to hide.  Anchored at the previous batch's END so training
         # time never counts as idleness; reconnect gaps are excluded
         # (anchor reset in _connect).
+        _health.beat("worker_evaluate")
         t_start = time.monotonic()
         if _tele.enabled() and self._last_batch_end is not None:
             idle = t_start - self._last_batch_end

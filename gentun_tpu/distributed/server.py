@@ -71,6 +71,10 @@ class DistributedPopulation(Population):
     - ``fault_injector``: chaos testing (``distributed/faults.py``).
       Passed through to an owned :class:`JobBroker`; ignored when an
       external ``broker`` is shared (inject on that broker directly).
+    - ``straggler_floor_s``/``straggler_k``/``straggler_requeue``: stall
+      watchdog tuning for an owned broker (``telemetry/health.py``; active
+      only while the ops plane is on — see docs/OBSERVABILITY.md "Live ops
+      plane").  Ignored when sharing an external ``broker``.
     """
 
     def __init__(
@@ -98,6 +102,9 @@ class DistributedPopulation(Population):
         fitness_store: Optional[str] = None,
         speculative_fill=False,
         fault_injector=None,
+        straggler_floor_s: float = 30.0,
+        straggler_k: float = 4.0,
+        straggler_requeue: bool = False,
     ):
         if failed_policy not in ("raise", "penalize"):
             raise ValueError(f"unknown failed_policy {failed_policy!r}")
@@ -146,6 +153,9 @@ class DistributedPopulation(Population):
                 heartbeat_timeout=heartbeat_timeout,
                 max_attempts=max_attempts,
                 fault_injector=fault_injector,
+                straggler_floor_s=straggler_floor_s,
+                straggler_k=straggler_k,
+                straggler_requeue=straggler_requeue,
             ).start()
             self._owns_broker = True
 

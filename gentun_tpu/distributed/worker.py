@@ -139,6 +139,16 @@ def main(argv=None) -> int:
                     help="collect spans for evaluated job groups and ship "
                          "them to the master in result frames (equivalent to "
                          "GENTUN_TPU_TELEMETRY=1; see docs/OBSERVABILITY.md)")
+    ap.add_argument("--ops-port", type=int, default=None, metavar="PORT",
+                    help="serve the live ops plane (/metrics /healthz /statusz "
+                         "/debugz/flight) on 127.0.0.1:PORT and arm the flight "
+                         "recorder; 0 picks an ephemeral port (logged).  Off "
+                         "by default — see docs/OBSERVABILITY.md 'Live ops "
+                         "plane'.")
+    ap.add_argument("--ops-host", default="127.0.0.1", metavar="ADDR",
+                    help="bind address for --ops-port (default 127.0.0.1; "
+                         "bind a routable address only on a trusted network "
+                         "— the endpoints are unauthenticated)")
     mh = ap.add_argument_group(
         "multi-host",
         "run ONE logical worker across a multi-process jax cluster (e.g. all "
@@ -167,10 +177,19 @@ def main(argv=None) -> int:
         raise SystemExit(f"--capacity must be a positive integer, got {args.capacity}")
     if args.prefetch_depth is not None and args.prefetch_depth < 0:
         raise SystemExit(f"--prefetch-depth must be >= 0, got {args.prefetch_depth}")
+    if args.ops_port is not None and not 0 <= args.ops_port <= 65535:
+        raise SystemExit(f"--ops-port must be in [0, 65535], got {args.ops_port}")
     if args.telemetry:
         from ..telemetry import spans as tele_spans
 
         tele_spans.enable()
+    if args.ops_port is not None:
+        from ..telemetry.ops_server import start_ops_server
+
+        ops = start_ops_server(port=args.ops_port, host=args.ops_host)
+        logging.getLogger("gentun_tpu.distributed").info(
+            "ops plane serving on %s (/metrics /healthz /statusz /debugz/flight)",
+            ops.url)
     if (args.num_processes is not None or args.process_id is not None) and args.coordinator is None:
         raise SystemExit("--num-processes/--process-id require --coordinator")
     multihost = args.coordinator is not None

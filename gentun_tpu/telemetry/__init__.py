@@ -11,6 +11,14 @@ Three small zero-dependency modules (see ``docs/OBSERVABILITY.md``):
   per-run ``telemetry.jsonl`` and summarises exact p50/p95/p99 per span
   kind plus counter totals, merged across worker reports.
 
+Plus the live ops plane (OBSERVABILITY.md "Live ops plane"):
+
+- :mod:`.health` — heartbeat registry, status providers, and the
+  :class:`~.health.StallWatchdog` straggler detector behind ``/healthz``.
+- :mod:`.flight` — always-on bounded flight recorder (crash black box).
+- :mod:`.ops_server` — ``/metrics`` + ``/healthz`` + ``/statusz`` +
+  ``/debugz/flight`` on a stdlib HTTP server in a daemon thread.
+
 Quick start::
 
     from gentun_tpu import telemetry
@@ -19,6 +27,9 @@ Quick start::
 """
 
 from .export import RunTelemetry, active_run, end_run, start_run
+from .flight import FlightRecorder
+from .health import StallWatchdog
+from .ops_server import OpsServer, active_ops_server, start_ops_server, stop_ops_server
 from .registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -61,4 +72,10 @@ __all__ = [
     "attach",
     "capture",
     "ingest",
+    "StallWatchdog",
+    "FlightRecorder",
+    "OpsServer",
+    "start_ops_server",
+    "stop_ops_server",
+    "active_ops_server",
 ]

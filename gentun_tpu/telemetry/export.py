@@ -96,7 +96,11 @@ class RunTelemetry:
         self._write({"type": "summary", **summ})
         if self._installed:
             _spans.set_run_sink(None)
-            _spans.disable()
+            # The flight recorder (ops plane) may still be consuming
+            # records; only stop collection when this run was the last
+            # sink — the mirror of flight.disable()'s has_run_sink check.
+            if not _spans.has_flight_sink():
+                _spans.disable()
             self._installed = False
         with self._lock:
             if self._fh is not None:

@@ -271,10 +271,18 @@ class MetricsRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format (scrape- or textfile-ready)."""
+        """Prometheus text exposition format (scrape- or textfile-ready).
+
+        Label values are escaped per the exposition format spec
+        (backslash, double-quote, newline — in that order, so an
+        already-present backslash can't re-arm the later replacements).
+        """
+
+        def esc(v: str) -> str:
+            return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
 
         def fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
-            parts = [f'{k}="{v}"' for k, v in sorted(labels.items())]
+            parts = [f'{k}="{esc(v)}"' for k, v in sorted(labels.items())]
             if extra:
                 parts.append(extra)
             return "{" + ",".join(parts) + "}" if parts else ""

@@ -44,6 +44,9 @@ __all__ = [
     "attach",
     "capture",
     "set_run_sink",
+    "has_run_sink",
+    "set_flight_sink",
+    "has_flight_sink",
 ]
 
 # Module-level switch.  A plain bool read is the entire disabled-path cost
@@ -63,6 +66,11 @@ _CAPTURE: contextvars.ContextVar[Optional[List[Dict[str, Any]]]] = contextvars.C
 # a lock only on mutation; the read is a plain attribute load.
 _run_sink = None
 _sink_lock = threading.Lock()
+
+# The active flight recorder ring (telemetry/flight.py), fed a copy of
+# EVERY record regardless of capture/run-sink routing — the black box
+# must see worker-side captured spans too.  One attribute load when off.
+_flight_sink = None
 
 
 def enabled() -> bool:
@@ -88,6 +96,23 @@ def set_run_sink(sink) -> None:
         _run_sink = sink
 
 
+def has_run_sink() -> bool:
+    return _run_sink is not None
+
+
+def has_flight_sink() -> bool:
+    return _flight_sink is not None
+
+
+def set_flight_sink(sink) -> None:
+    """Install (or clear) the flight-recorder ring.  Managed by
+    ``telemetry/flight.py``; unlike the run sink it is NOT bypassed by
+    :class:`capture` — the ring sees every record this process emits."""
+    global _flight_sink
+    with _sink_lock:
+        _flight_sink = sink
+
+
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]
 
@@ -101,6 +126,9 @@ def _emit(rec: Dict[str, Any], dur_kind: Optional[Tuple[float, str]] = None) -> 
     on the master instead, so in-process workers (which share this
     registry) don't double-count.
     """
+    fl = _flight_sink
+    if fl is not None:
+        fl.record(rec)
     cap = _CAPTURE.get()
     if cap is not None:
         cap.append(rec)

@@ -16,6 +16,15 @@ telemetry artifact (asserted: the event kinds equal the kinds fired), and
 bit-identity against the telemetry-free clean run doubles as proof that
 telemetry never perturbs a search trajectory.
 
+A third act (``run_stall_ops``) replays the worker-stall fault under the
+live ops plane (``start_ops_server``, see docs/OBSERVABILITY.md "Live
+ops plane"): an injected ``hang`` must be flagged by the stall watchdog
+and surface BOTH as a ``straggler_detected`` event in the telemetry
+artifact AND as a 503 on ``/healthz`` with a straggler reason — then
+self-heal to 200 when the stalled result lands.  It runs separately from
+the composed plan above because the composed schedule is count-based and
+timing-sensitive: observation load must not decide which faults fire.
+
 CPU-only, a few seconds: `python scripts/chaos_run.py` writes
 ``scripts/chaos_run.json``.  The plan is serialized into the artifact, so
 a recorded run can be replayed exactly.
@@ -29,6 +38,8 @@ import socket
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 
@@ -44,6 +55,7 @@ from gentun_tpu.distributed import (  # noqa: E402
     MasterKilled,
 )
 from gentun_tpu.telemetry import RunTelemetry  # noqa: E402
+from gentun_tpu.telemetry.ops_server import start_ops_server, stop_ops_server  # noqa: E402
 from gentun_tpu.utils import Checkpointer  # noqa: E402
 
 GENERATIONS = 5
@@ -83,6 +95,15 @@ def _worker(port, injector=None, worker_id=None):
     return stop
 
 
+def _healthz(url):
+    """(status_code, reasons) — non-2xx handled, not raised."""
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=5.0) as resp:
+            return resp.status, json.loads(resp.read()).get("reasons", [])
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()).get("reasons", [])
+
+
 def _snapshot(ga):
     return {
         "best_fitness_history": [r["best_fitness"] for r in ga.history],
@@ -102,13 +123,19 @@ def run() -> dict:
     clean.run(GENERATIONS)
 
     # -- the composed plan: every fault kind, against a live search --------
+    # The `at` schedule is tuned to the pipelined dispatch plane's
+    # observed per-worker event counts (chaos-w0 sees ~7 pre-evals and
+    # ~6 result sends over the 5 generations — double buffering spreads
+    # jobs differently than the serial loop the original schedule was
+    # tuned against).  The hang is last so the reap it provokes cannot
+    # starve the later client_send specs of their events.
     worker_plan = FaultPlan([
         FaultSpec(hook="client_send", kind="drop_connection", match_type="results", at=0),
+        FaultSpec(hook="client_send", kind="duplicate_result", match_type="results", at=2),
         FaultSpec(hook="client_send", kind="corrupt", match_type="results", at=3),
-        FaultSpec(hook="client_send", kind="duplicate_result", match_type="results", at=6),
         FaultSpec(hook="client_recv", kind="delay", at=2, delay=0.05),
         FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=1),
-        FaultSpec(hook="worker_pre_eval", kind="hang", at=8, duration=2.5),
+        FaultSpec(hook="worker_pre_eval", kind="hang", at=5, duration=2.5),
     ], seed=2026)
     master_plan = FaultPlan([
         FaultSpec(hook="master_boundary", kind="kill_master", generation=2),
@@ -213,6 +240,116 @@ def run() -> dict:
     }
 
 
+def run_stall_ops() -> dict:
+    """Worker-stall act under the live ops plane: one injected ``hang``
+    (2.5 s, far past the 0.5 s watchdog floor) on a 2-worker fleet with
+    the heartbeat reaper pinned out (``heartbeat_timeout=30``), so the
+    stall watchdog is the only component that can act.  Asserts the stall
+    surfaces BOTH as a ``straggler_detected`` event in the telemetry
+    artifact AND as a straggler-attributed 503 on ``/healthz``, which
+    self-heals to 200 when the hung worker's result finally lands."""
+    floor_s, hang_s = 0.5, 2.5
+    plan = FaultPlan([
+        FaultSpec(hook="worker_pre_eval", kind="hang", at=1, duration=hang_s),
+    ], seed=2026)
+    inj = FaultInjector(plan)
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_stall_telemetry.jsonl")
+    flight_path = os.path.join(script_dir, ".chaos_stall_flight.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-stall").install()
+    ops_srv = start_ops_server(port=0, flight_path=flight_path)
+    healthz_samples = []  # (t_rel_s, status, straggler_attributed)
+    stop_poll = threading.Event()
+    t0 = time.monotonic()
+
+    def _poll_healthz():
+        while not stop_poll.is_set():
+            code, reasons = _healthz(ops_srv.url)
+            healthz_samples.append((round(time.monotonic() - t0, 3), code,
+                                    any("straggler" in r for r in reasons)))
+            time.sleep(0.1)
+
+    poller = threading.Thread(target=_poll_healthz, daemon=True)
+    port = _free_port()
+    stops = [_worker(port, injector=inj, worker_id="stall-w0"),
+             _worker(port, worker_id="stall-w1")]
+    poller.start()
+    try:
+        pop = DistributedPopulation(
+            OneMax, size=POP_SIZE, seed=POP_SEED, host="127.0.0.1", port=port,
+            job_timeout=120, heartbeat_timeout=30.0, straggler_floor_s=floor_s)
+        try:
+            ga = GeneticAlgorithm(pop, seed=GA_SEED)
+            ga.run(2)
+            wall = time.monotonic() - t0
+            leaked = pop.broker.outstanding()
+            # Final verdict sampled while the fleet is quiescent but
+            # still alive — polling through pop.close() would race the
+            # broker's own shutdown (sources unregistering) and could
+            # record a shutdown transient as the last word.
+            stop_poll.set()
+            poller.join(timeout=5.0)
+            final_code, final_reasons = _healthz(ops_srv.url)
+            healthz_samples.append(
+                (round(time.monotonic() - t0, 3), final_code,
+                 any("straggler" in r for r in final_reasons)))
+        finally:
+            pop.close()
+    finally:
+        stop_poll.set()
+        poller.join(timeout=5.0)
+        for s in stops:
+            s.set()
+        tele_summary = run_tele.close()
+        stop_ops_server()
+        if os.path.exists(flight_path):
+            os.unlink(flight_path)
+
+    assert inj.fired, "the hang never fired"
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    # (1) the stall surfaced as straggler telemetry naming the hung worker
+    straggler_events = [r for r in tele_lines
+                        if r.get("type") == "event"
+                        and r.get("name") == "straggler_detected"]
+    assert straggler_events, "worker hang never surfaced as a straggler event"
+    assert any(e["data"]["worker_id"] == "stall-w0" for e in straggler_events), (
+        f"straggler events name the wrong worker: "
+        f"{[e['data'] for e in straggler_events]}")
+    # (2) and flipped /healthz to a straggler-attributed 503, then healed
+    assert any(code == 503 and strag for _, code, strag in healthz_samples), (
+        f"healthz never flipped 503 for the stall: {healthz_samples}")
+    assert final_code == 200, (
+        f"healthz did not recover: final={final_code} samples={healthz_samples}")
+    transitions = []
+    for t, code, _ in healthz_samples:
+        if not transitions or transitions[-1]["status"] != code:
+            transitions.append({"t_s": t, "status": code})
+    detected = sum(c["value"] for c in tele_summary["counters"]
+                   if c["name"] == "stragglers_detected_total")
+    assert detected >= 1
+
+    return {
+        "workers": 2,
+        "population_size": POP_SIZE,
+        "fault_plan": plan.to_dict(),
+        "straggler_floor_s": floor_s,
+        "hang_s": hang_s,
+        "heartbeat_timeout_s": 30.0,
+        "straggler_events": len(straggler_events),
+        "straggler_worker": "stall-w0",
+        "stragglers_detected_total": detected,
+        "healthz_transitions": transitions,
+        "healthz_samples": len(healthz_samples),
+        "healthz_recovered": True,
+        "wall_s": round(wall, 3),
+    }
+
+
 def run_async_smoke() -> dict:
     """Async-mode chaos smoke: the steady-state engine under injected
     faults (a dropped ``results`` frame mid-send and an evaluation
@@ -222,8 +359,11 @@ def run_async_smoke() -> dict:
     ``fault_injected`` telemetry event, and the broker ends quiescent."""
     budget = 24
     plan = FaultPlan([
+        # fail_eval on the FIRST pre-eval: after the dropped connection
+        # the clean worker can drain the whole budget before this one
+        # rejoins, so only the first batch is guaranteed to reach it.
+        FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=0),
         FaultSpec(hook="client_send", kind="drop_connection", match_type="results", at=0),
-        FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=3),
     ], seed=2026)
     inj = FaultInjector(plan)
 
@@ -287,6 +427,7 @@ def run_async_smoke() -> dict:
 
 if __name__ == "__main__":
     out = run()
+    out["stall_ops"] = run_stall_ops()
     out["async_smoke"] = run_async_smoke()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")

@@ -1,0 +1,193 @@
+"""gentun-top: a refreshing terminal dashboard for the live ops plane.
+
+Polls a master's or worker's ops server (``--ops-port`` /
+``start_ops_server``, see docs/OBSERVABILITY.md "Live ops plane") and
+renders ``/statusz`` + ``/healthz`` + ``/metrics`` as a top(1)-style
+screen: health verdict, heartbeat ages, the broker's per-worker fleet
+table, engine progress, and the headline counters.
+
+    python scripts/gentun_top.py --url http://127.0.0.1:8080
+    python scripts/gentun_top.py --url http://127.0.0.1:8080 --once
+
+Stdlib only (urllib + ANSI escapes) — usable over ssh on a TPU-VM with
+nothing installed.  ``--once`` prints a single frame without touching
+the screen (pipe-friendly); otherwise the screen redraws every
+``--interval`` seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD, _DIM, _RED, _GREEN, _YELLOW, _RESET = (
+    "\x1b[1m", "\x1b[2m", "\x1b[31m", "\x1b[32m", "\x1b[33m", "\x1b[0m")
+
+#: Counters worth a line on the dashboard, in display order (the full
+#: registry instrument set — see docs/OBSERVABILITY.md metric catalog).
+_HEADLINE_COUNTERS = (
+    "stragglers_detected_total",
+    "stragglers_requeued_total",
+    "population_cache_hits_total",
+    "population_dedup_collapsed_total",
+    "population_speculative_total",
+    "faults_injected_total",
+)
+
+
+def _get(url: str, timeout: float):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _fetch(base: str, timeout: float):
+    """(healthz, statusz, metrics_text) — None for anything unreachable."""
+    try:
+        _, hz = _get(base + "/healthz", timeout)
+        _, sz = _get(base + "/statusz", timeout)
+        _, mx = _get(base + "/metrics", timeout)
+        return json.loads(hz), json.loads(sz), mx.decode("utf-8")
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return None, None, str(e)
+
+
+def _parse_counters(metrics_text: str):
+    """name -> summed value across label sets (enough for headlines)."""
+    totals = {}
+    for line in metrics_text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(" ", 1)
+            name = name_part.split("{", 1)[0]
+            totals[name] = totals.get(name, 0.0) + float(value)
+        except ValueError:
+            continue
+    return totals
+
+
+def _fmt_age(age):
+    if age is None:
+        return "-"
+    return f"{age:.1f}s"
+
+
+def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
+    B, D, R, G, Y, X = ((_BOLD, _DIM, _RED, _GREEN, _YELLOW, _RESET)
+                        if color else ("",) * 6)
+    lines = []
+    if healthz is None:
+        lines.append(f"{R}gentun-top: {base} unreachable{X} ({metrics_text})")
+        return "\n".join(lines)
+
+    ok = healthz.get("status") == "ok"
+    verdict = f"{G}HEALTHY{X}" if ok else f"{R}UNHEALTHY{X}"
+    lines.append(f"{B}gentun-top{X}  {base}  [{verdict}]  "
+                 f"up {statusz.get('uptime_s', 0):.0f}s  pid {statusz.get('pid')}")
+    for reason in healthz.get("reasons", []):
+        lines.append(f"  {R}! {reason}{X}")
+
+    hbs = statusz.get("heartbeats", {})
+    if hbs:
+        lines.append(f"{B}heartbeats{X}")
+        for name, hb in hbs.items():
+            mark = f"{R}STALE{X}" if hb.get("stale") else f"{G}ok{X}"
+            gate = f"gate {hb['timeout_s']}s" if hb.get("timeout_s") else "advisory"
+            lines.append(f"  {name:<20} {_fmt_age(hb.get('age_s')):>8}  "
+                         f"{mark}  {D}{gate}{X}")
+
+    eng = statusz.get("engine")
+    if eng:
+        if eng.get("mode") == "async":
+            prog = (f"completed {eng.get('completed')}/{eng.get('dispatched')} "
+                    f"in-flight {eng.get('in_flight')} queued {eng.get('queued')}")
+        else:
+            prog = (f"generation {eng.get('generation')} "
+                    f"pop {eng.get('population_size')}")
+        lines.append(f"{B}engine{X} [{eng.get('mode', '?')}]  {prog}  "
+                     f"best {eng.get('best_fitness')}  "
+                     f"{D}trace {eng.get('trace_id')}{X}")
+
+    fleet = statusz.get("fleet")
+    if fleet:
+        lines.append(
+            f"{B}fleet{X}  queue {fleet.get('queue_depth')}  "
+            f"open {fleet.get('open_jobs')}  in-flight {fleet.get('jobs_in_flight')}  "
+            f"straggler-threshold {fleet.get('straggler_threshold_s')}s"
+            + ("  requeue on" if fleet.get("straggler_requeue") else ""))
+        workers = fleet.get("workers", [])
+        if workers:
+            lines.append(f"  {D}{'worker':<16}{'cap':>4}{'pre':>4}{'credit':>7}"
+                         f"{'busy':>5}{'chips':>6}{'seen':>8}  backend{X}")
+            for w in workers:
+                lines.append(
+                    f"  {str(w.get('worker_id', '?'))[:16]:<16}"
+                    f"{w.get('capacity', '-'):>4}"
+                    f"{w.get('prefetch_depth', '-'):>4}"
+                    f"{w.get('credit', '-'):>7}"
+                    f"{w.get('jobs_in_flight', '-'):>5}"
+                    f"{w.get('n_chips', '-'):>6}"
+                    f"{_fmt_age(w.get('last_seen_age_s')):>8}  "
+                    f"{w.get('backend') or '-'}")
+        for s in fleet.get("stragglers", []):
+            lines.append(f"  {Y}~ straggler {s['job_id']} on {s['worker_id']} "
+                         f"({s['age_s']}s > {s['threshold_s']}s){X}")
+
+    worker = statusz.get("worker")
+    if worker:
+        lines.append(f"{B}worker{X}  {worker.get('worker_id')}  "
+                     f"cap {worker.get('capacity')}  "
+                     f"done {worker.get('jobs_done')}  "
+                     f"{'connected' if worker.get('connected') else 'DISCONNECTED'}")
+
+    totals = _parse_counters(metrics_text or "")
+    headline = [(n, totals[n]) for n in _HEADLINE_COUNTERS if n in totals]
+    if headline:
+        lines.append(f"{B}counters{X}  " + "  ".join(
+            f"{n.replace('_total', '')}={v:g}" for n, v in headline))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/gentun_top.py",
+        description="terminal dashboard for a gentun_tpu ops server")
+    ap.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="ops server base URL (the --ops-port address)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    ap.add_argument("--timeout", type=float, default=3.0,
+                    help="per-request timeout in seconds")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args(argv)
+    if args.interval <= 0:
+        raise SystemExit(f"--interval must be positive, got {args.interval}")
+    base = args.url.rstrip("/")
+    color = not args.no_color and (args.once or sys.stdout.isatty())
+
+    if args.once:
+        print(render(base, *_fetch(base, args.timeout), color=color))
+        return 0
+    try:
+        while True:
+            frame = render(base, *_fetch(base, args.timeout), color=color)
+            sys.stdout.write(_CLEAR + frame + "\n" +
+                             f"{_DIM}refresh {args.interval}s — Ctrl-C to quit{_RESET}\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -87,6 +87,16 @@ _ENV_SKIP_PATTERNS = (
      "jaxlib's gloo TCP collectives lost a peer mid-collective (abort "
      "cascade — seen with 8 ranks contending for this box's single CPU "
      "core)"),
+    # The coordination-service flavor of the same cascade: a child that
+    # never errored itself is torn down by jax.distributed because a
+    # peer died ("another task died").  Harmless to recognize — a child
+    # with a REAL bug dies with its own traceback, lacks this line, and
+    # still wins over every peer's signature (see _resolve_failures).
+    ("Terminating process because the JAX distributed service detected "
+     "fatal errors",
+     "jax coordination service tore this child down after a peer died "
+     "(peer-abort cascade; the peers carried gloo environment "
+     "signatures)"),
 )
 
 

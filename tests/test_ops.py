@@ -1,0 +1,708 @@
+"""Live ops plane tests: health registry, stall watchdog, ops server,
+flight recorder, and the stall→503→recovery acceptance path.
+
+Covers the ops-plane acceptance criteria (docs/OBSERVABILITY.md "Live
+ops plane"):
+
+- heartbeat registry semantics: gating vs advisory sources, the
+  enable-time re-stamp (no instant 503), disabled path is a no-op,
+- StallWatchdog: ``max(floor, k × p95)`` threshold with the min-sample
+  gate, flag/unflag discipline, counter + telemetry event + requeue
+  callback on detection,
+- OpsServer endpoints: /metrics is valid Prometheus text exposition,
+  /healthz flips 200→503 on a stale gating source, /statusz carries
+  heartbeats + providers, /debugz/flight serves the ring, 404 catalog,
+- FlightRecorder: bounded ring, dump format, excepthook/SIGTERM dumpers,
+- Prometheus label-value escaping round-trip (exposition spec),
+- a killed run's truncated ``telemetry.jsonl`` stays line-parseable and
+  the flight ring covers its tail,
+- end-to-end: a 2-worker fleet with an injected worker stall flips
+  /healthz to 503 within the watchdog window, the straggler is requeued
+  to the healthy worker, and /healthz recovers to 200.
+"""
+
+import json
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, genetic_cnn_genome
+from gentun_tpu.telemetry import flight as flight_mod
+from gentun_tpu.telemetry import health as health_mod
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.export import RunTelemetry
+from gentun_tpu.telemetry.health import StallWatchdog
+from gentun_tpu.telemetry.ops_server import (
+    OpsServer,
+    active_ops_server,
+    start_ops_server,
+    stop_ops_server,
+)
+from gentun_tpu.telemetry.registry import MetricsRegistry, get_registry
+
+
+@pytest.fixture(autouse=True)
+def _pristine_ops():
+    """Ops state is process-global; every test starts and ends clean."""
+    stop_ops_server()
+    health_mod.reset()
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    stop_ops_server()
+    health_mod.reset()
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def record(self, rec):
+        self.records.append(rec)
+
+
+def _get(url, timeout=5.0):
+    """(status, body bytes, content-type) — non-2xx handled, not raised."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read(), resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type", "")
+
+
+# ---------------------------------------------------------------------------
+# heartbeat registry + status providers
+# ---------------------------------------------------------------------------
+
+
+class TestHealthRegistry:
+    def test_disabled_beat_is_noop(self):
+        assert not health_mod.enabled()
+        health_mod.beat("engine_loop")
+        assert health_mod.heartbeats() == {}
+
+    def test_beat_auto_registers_advisory(self):
+        health_mod.enable()
+        health_mod.beat("engine_loop")
+        hb = health_mod.heartbeats()["engine_loop"]
+        assert hb["timeout_s"] is None
+        assert not hb["stale"]
+        # advisory sources never gate, no matter how old
+        ok, reasons = health_mod.check_health()
+        assert ok and reasons == []
+
+    def test_gating_source_goes_stale(self):
+        health_mod.enable()
+        health_mod.register_source("broker_loop", timeout=0.05)
+        time.sleep(0.12)
+        ok, reasons = health_mod.check_health()
+        assert not ok
+        assert any("broker_loop" in r and "stale" in r for r in reasons)
+        # a beat heals it
+        health_mod.beat("broker_loop")
+        ok, reasons = health_mod.check_health()
+        assert ok and reasons == []
+
+    def test_enable_restamps_sources(self):
+        """Ages accrued while the plane was off must not cause an instant
+        503 on the first scrape after enabling."""
+        health_mod.register_source("broker_loop", timeout=0.05)
+        time.sleep(0.12)  # stale if the old stamp survived enable()
+        health_mod.enable()
+        ok, reasons = health_mod.check_health()
+        assert ok, reasons
+
+    def test_unregister_source(self):
+        health_mod.enable()
+        health_mod.register_source("x", timeout=0.01)
+        health_mod.unregister_source("x")
+        time.sleep(0.03)
+        assert health_mod.check_health() == (True, [])
+
+    def test_status_providers_lazy_and_error_isolated(self):
+        calls = []
+
+        def good():
+            calls.append(1)
+            return {"n": 7}
+
+        def bad():
+            raise RuntimeError("boom")
+
+        health_mod.register_status_provider("engine", good)
+        health_mod.register_status_provider("broken", bad)
+        assert calls == []  # registration never calls
+        snap = health_mod.status_snapshot()
+        assert snap["engine"] == {"n": 7}
+        assert "RuntimeError" in snap["broken"]["error"]
+
+    def test_unregister_provider_identity_checked(self):
+        fn_old = lambda: {"gen": 1}  # noqa: E731
+        fn_new = lambda: {"gen": 2}  # noqa: E731
+        health_mod.register_status_provider("engine", fn_old)
+        health_mod.register_status_provider("engine", fn_new)  # last wins
+        health_mod.unregister_status_provider("engine", fn_old)  # stale evict: no-op
+        assert health_mod.status_snapshot()["engine"] == {"gen": 2}
+        health_mod.unregister_status_provider("engine", fn_new)
+        assert health_mod.status_snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestStallWatchdog:
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            StallWatchdog(floor_s=0)
+        with pytest.raises(ValueError):
+            StallWatchdog(k=-1)
+
+    def test_threshold_floor_until_min_samples(self):
+        wd = StallWatchdog(floor_s=2.0, k=4.0, min_samples=3)
+        assert wd.threshold() == 2.0
+        for i in range(3):  # instant round trips: p95 ≈ 0, floor still wins
+            wd.job_started(f"j{i}", "w0")
+            wd.job_finished(f"j{i}")
+        assert wd.threshold() == 2.0
+
+    def test_threshold_tracks_p95(self):
+        wd = StallWatchdog(floor_s=0.001, k=2.0, min_samples=4)
+        wd._rtts.extend([1.0, 1.0, 1.0, 10.0])  # p95 lands on the outlier
+        assert wd.threshold() == pytest.approx(20.0)
+
+    def test_flags_once_and_counts(self):
+        wd = StallWatchdog(floor_s=1.0, k=4.0)
+        wd.job_started("j1", "w0")
+        future = time.monotonic() + 5.0
+        newly = wd.check(now=future)
+        assert [s["job_id"] for s in newly] == ["j1"]
+        assert newly[0]["worker_id"] == "w0"
+        assert wd.detected_total == 1
+        assert wd.check(now=future + 1.0) == []  # flagged at most once
+        assert wd.detected_total == 1
+        snap = get_registry().snapshot()
+        (c,) = [c for c in snap["counters"]
+                if c["name"] == "stragglers_detected_total"]
+        assert c["value"] == 1.0 and c["labels"] == {"worker": "w0"}
+
+    def test_finish_clears_flag_and_samples_rtt(self):
+        wd = StallWatchdog(floor_s=0.001, k=4.0)
+        wd.job_started("j1", "w0")
+        wd.check(now=time.monotonic() + 1.0)
+        assert wd.stragglers()
+        wd.job_finished("j1")
+        assert wd.stragglers() == []
+        assert wd.in_flight() == 0
+        assert len(wd._rtts) == 1  # finish is a round trip
+
+    def test_removed_takes_no_rtt_sample(self):
+        wd = StallWatchdog(floor_s=1.0)
+        wd.job_started("j1", "w0")
+        wd.job_removed("j1")
+        assert wd.in_flight() == 0
+        assert len(wd._rtts) == 0  # a requeue is not a round trip
+
+    def test_on_straggler_callback_and_event(self):
+        spans_mod.enable()
+        sink = _ListSink()
+        spans_mod.set_run_sink(sink)
+        hits = []
+        wd = StallWatchdog(floor_s=0.5, on_straggler=hits.append)
+        wd.job_started("j9", "w1")
+        wd.check(now=time.monotonic() + 2.0)
+        assert len(hits) == 1 and hits[0]["job_id"] == "j9"
+        events = [r for r in sink.records if r.get("type") == "event"]
+        assert [e["name"] for e in events] == ["straggler_detected"]
+        assert events[0]["data"]["worker_id"] == "w1"
+
+    def test_straggler_gates_check_health(self):
+        health_mod.enable()
+        wd = StallWatchdog(floor_s=0.02)
+        health_mod.register_watchdog(wd)
+        wd.job_started("j1", "w0")
+        time.sleep(0.06)
+        ok, reasons = health_mod.check_health()  # check_health sweeps itself
+        assert not ok
+        assert any("straggler" in r and "j1" in r for r in reasons)
+        wd.job_finished("j1")
+        assert health_mod.check_health() == (True, [])
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, tmp_path):
+        rec = flight_mod.FlightRecorder(capacity=4, path=str(tmp_path / "f.jsonl"))
+        for i in range(10):
+            rec.record({"type": "span", "kind": "k", "i": i})
+        assert len(rec) == 4
+        assert rec.total == 10
+        assert [r["i"] for r in rec.snapshot()] == [6, 7, 8, 9]  # newest kept
+
+    def test_dump_format(self, tmp_path):
+        rec = flight_mod.FlightRecorder(capacity=8, path=str(tmp_path / "f.jsonl"))
+        for i in range(12):
+            rec.record({"type": "event", "name": "tick", "i": i})
+        out = rec.dump(reason="unit")
+        lines = [json.loads(l) for l in open(out, encoding="utf-8")]
+        head = lines[0]
+        assert head["type"] == "flight" and head["reason"] == "unit"
+        assert head["capacity"] == 8
+        assert head["recorded"] == 8 and head["dropped"] == 4
+        assert len(lines) == 1 + 8
+        assert lines[-1]["i"] == 11
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            flight_mod.FlightRecorder(capacity=0)
+
+    def test_enable_routes_spans_through_ring(self, tmp_path):
+        rec = flight_mod.enable(path=str(tmp_path / "f.jsonl"), capacity=16)
+        try:
+            assert spans_mod.enabled()  # flight arms span collection
+            assert flight_mod.active() is rec
+            with spans_mod.span("gen"):
+                pass
+            spans_mod.record_event("tick")
+            kinds = {r.get("kind") or r.get("name") for r in rec.snapshot()}
+            assert kinds == {"gen", "tick"}
+        finally:
+            flight_mod.disable()
+        assert flight_mod.active() is None
+        assert not spans_mod.enabled()  # no run sink held it open
+
+    def test_disable_keeps_spans_for_run_sink(self, tmp_path):
+        flight_mod.enable(path=str(tmp_path / "f.jsonl"))
+        spans_mod.set_run_sink(_ListSink())
+        flight_mod.disable()
+        assert spans_mod.enabled()  # RunTelemetry still consuming
+
+    def test_run_close_keeps_spans_for_flight(self, tmp_path):
+        """The mirror case: closing a RunTelemetry artifact must not
+        silence the flight recorder a live ops plane still holds."""
+        rec = flight_mod.enable(path=str(tmp_path / "f.jsonl"))
+        try:
+            with RunTelemetry(str(tmp_path / "t.jsonl"), label="x"):
+                pass
+            assert spans_mod.enabled()  # flight ring still consuming
+            before = rec.total
+            spans_mod.record_event("after_run_close")
+            assert rec.total == before + 1
+        finally:
+            flight_mod.disable()
+        assert not spans_mod.enabled()
+
+    def test_excepthook_dumps_then_chains(self, tmp_path):
+        path = str(tmp_path / "f.jsonl")
+        rec = flight_mod.enable(path=path)
+        with spans_mod.span("doomed"):
+            pass
+        chained = []
+        saved = flight_mod._prev_excepthook
+        flight_mod._prev_excepthook = lambda *a: chained.append(a)
+        try:
+            flight_mod._excepthook(ValueError, ValueError("boom"), None)
+        finally:
+            flight_mod._prev_excepthook = saved
+        assert len(chained) == 1  # original hook still ran
+        lines = [json.loads(l) for l in open(path, encoding="utf-8")]
+        assert lines[0]["reason"] == "unhandled_exception"
+        names = [r.get("name") for r in lines[1:]]
+        assert "unhandled_exception" in names  # the exception itself is in the ring
+        kinds = [r.get("kind") for r in lines[1:]]
+        assert "doomed" in kinds  # ...alongside the tail of the run
+        (ev,) = [r for r in lines[1:] if r.get("name") == "unhandled_exception"]
+        assert ev["data"] == {"exc_type": "ValueError", "exc": "boom"}
+        assert len(rec) >= 2
+
+    def test_sigterm_handler_dumps_then_chains(self, tmp_path):
+        path = str(tmp_path / "f.jsonl")
+        flight_mod.enable(path=path)
+        spans_mod.record_event("last_words")
+        chained = []
+        saved = flight_mod._prev_sigterm
+        flight_mod._prev_sigterm = lambda *a: chained.append(a)
+        try:
+            flight_mod._sigterm_handler(signal.SIGTERM, None)
+        finally:
+            flight_mod._prev_sigterm = saved
+        assert chained == [(signal.SIGTERM, None)]
+        lines = [json.loads(l) for l in open(path, encoding="utf-8")]
+        assert lines[0]["reason"] == "sigterm"
+        assert any(r.get("name") == "last_words" for r in lines[1:])
+
+    def test_hooks_installed_once(self, tmp_path):
+        flight_mod.enable(path=str(tmp_path / "a.jsonl"))
+        hook_a = sys.excepthook
+        flight_mod.enable(path=str(tmp_path / "b.jsonl"))
+        assert sys.excepthook is hook_a  # no re-wrap, no chain-to-self
+
+
+# ---------------------------------------------------------------------------
+# prometheus escaping (exposition spec round-trip)
+# ---------------------------------------------------------------------------
+
+
+def _unescape_label_value(s):
+    """Inverse of the exposition-format escaping: \\\\ → \\, \\" → ",
+    \\n → newline — parsed char-by-char as a scraper would."""
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+class TestPrometheusEscaping:
+    @pytest.mark.parametrize("value", [
+        'back\\slash', 'quo"te', 'new\nline', 'all\\"of\nthem\\n', 'plain',
+        '\\', '"', '\n', 'trailing\\',
+    ])
+    def test_label_value_round_trips(self, value):
+        reg = MetricsRegistry()
+        reg.counter("escaped_total", path=value).inc()
+        text = reg.render_prometheus()
+        (line,) = [l for l in text.splitlines() if l.startswith("escaped_total{")]
+        # the sample line itself must stay one line (newline escaped)...
+        escaped = line[len('escaped_total{path="'):line.rindex('"')]
+        assert "\n" not in escaped
+        # ...and a spec-compliant parser must recover the original value
+        assert _unescape_label_value(escaped) == value
+
+    def test_multiple_labels_sorted_and_escaped(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", b='x"y', a="p\\q").set(1)
+        text = reg.render_prometheus()
+        assert 'g{a="p\\\\q",b="x\\"y"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# ops server endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestOpsServer:
+    def test_metrics_endpoint(self):
+        reg = MetricsRegistry()
+        reg.counter("jobs_total", worker="w0").inc(3)
+        srv = OpsServer(port=0, registry=reg).start()
+        try:
+            code, body, ctype = _get(srv.url + "/metrics")
+            assert code == 200
+            assert ctype.startswith("text/plain")
+            assert "version=0.0.4" in ctype
+            text = body.decode("utf-8")
+            assert "# TYPE jobs_total counter" in text
+            assert 'jobs_total{worker="w0"} 3' in text
+        finally:
+            srv.stop()
+
+    def test_healthz_flips_and_recovers(self):
+        health_mod.enable()
+        health_mod.register_source("broker_loop", timeout=0.05)
+        srv = OpsServer(port=0).start()
+        try:
+            code, body, _ = _get(srv.url + "/healthz")
+            assert code == 200
+            assert json.loads(body)["status"] == "ok"
+            time.sleep(0.12)  # let the gating source go stale
+            code, body, _ = _get(srv.url + "/healthz")
+            assert code == 503
+            payload = json.loads(body)
+            assert payload["status"] == "unhealthy"
+            assert any("broker_loop" in r for r in payload["reasons"])
+            health_mod.beat("broker_loop")  # self-heal
+            code, _, _ = _get(srv.url + "/healthz")
+            assert code == 200
+        finally:
+            srv.stop()
+
+    def test_statusz_carries_heartbeats_and_providers(self):
+        health_mod.enable()
+        health_mod.register_source("broker_loop", timeout=10.0)
+        health_mod.register_status_provider("engine", lambda: {"generation": 3})
+        srv = OpsServer(port=0).start()
+        try:
+            code, body, ctype = _get(srv.url + "/statusz")
+            assert code == 200 and ctype.startswith("application/json")
+            snap = json.loads(body)
+            assert snap["healthy"] is True
+            assert snap["pid"] > 0 and snap["uptime_s"] >= 0
+            assert snap["heartbeats"]["broker_loop"]["timeout_s"] == 10.0
+            assert snap["engine"] == {"generation": 3}
+        finally:
+            srv.stop()
+
+    def test_debugz_flight_404_without_recorder(self):
+        srv = OpsServer(port=0).start()
+        try:
+            code, body, _ = _get(srv.url + "/debugz/flight")
+            assert code == 404
+            assert "no flight recorder" in json.loads(body)["error"]
+        finally:
+            srv.stop()
+
+    def test_unknown_path_lists_endpoints(self):
+        srv = OpsServer(port=0).start()
+        try:
+            code, body, _ = _get(srv.url + "/nope")
+            assert code == 404
+            assert "/healthz" in json.loads(body)["endpoints"]
+        finally:
+            srv.stop()
+
+    def test_start_stop_lifecycle(self, tmp_path):
+        assert active_ops_server() is None
+        assert not health_mod.enabled() and not spans_mod.enabled()
+        srv = start_ops_server(port=0, flight_path=str(tmp_path / "f.jsonl"))
+        assert active_ops_server() is srv
+        assert health_mod.enabled()  # beats flow
+        assert spans_mod.enabled()  # flight recorder armed
+        assert flight_mod.active() is not None
+        with spans_mod.span("probe"):
+            pass
+        code, body, ctype = _get(srv.url + "/debugz/flight")
+        assert code == 200 and "ndjson" in ctype
+        lines = [json.loads(l) for l in body.decode("utf-8").splitlines()]
+        assert lines[0]["type"] == "flight" and lines[0]["reason"] == "debugz"
+        assert any(r.get("kind") == "probe" for r in lines[1:])
+        stop_ops_server()
+        assert active_ops_server() is None
+        assert not health_mod.enabled()
+        assert not spans_mod.enabled()  # ops plane was the only consumer
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(srv.url + "/healthz", timeout=0.5)
+
+    def test_ops_plane_off_by_default(self):
+        """A process that never opts in runs the untouched disabled paths
+        (the bit-identity guarantee rides on this)."""
+        assert active_ops_server() is None
+        assert flight_mod.active() is None
+        assert not health_mod.enabled()
+        assert not spans_mod.enabled()
+
+
+# ---------------------------------------------------------------------------
+# killed-run artifact: truncated telemetry.jsonl + flight tail
+# ---------------------------------------------------------------------------
+
+
+class TestKilledRunArtifacts:
+    def test_truncated_jsonl_parseable_and_flight_covers_tail(self, tmp_path):
+        """A SIGKILLed master never writes the summary line.  Because the
+        exporter flushes per record, the artifact must still be
+        line-parseable as-is — and the flight ring holds the same tail
+        for the crash dump."""
+        tele_path = tmp_path / "telemetry.jsonl"
+        flight_path = tmp_path / "flight.jsonl"
+        rec = flight_mod.enable(path=str(flight_path), capacity=64)
+        run = RunTelemetry(str(tele_path), label="doomed").install()
+        try:
+            for i in range(5):
+                with spans_mod.span("generation", {"generation": i}):
+                    pass
+            spans_mod.record_event("checkpoint", {"generation": 4})
+            # Simulate the kill: the file handle dies with the process —
+            # no close(), no summary line.
+            with run._lock:
+                run._fh.close()
+                run._fh = None
+        finally:
+            spans_mod.set_run_sink(None)
+            flight_mod.disable()
+
+        lines = [json.loads(l) for l in tele_path.read_text().splitlines()]
+        assert lines[0]["type"] == "run_start"
+        assert lines[-1]["type"] != "summary"  # truncated, by construction
+        gens = [r for r in lines if r.get("kind") == "generation"]
+        assert len(gens) == 5  # every pre-kill record is intact
+        assert any(r.get("name") == "checkpoint" for r in lines)
+
+        # the flight ring saw the same records; its dump reconstructs the tail
+        out = rec.dump(reason="postmortem")
+        flines = [json.loads(l) for l in open(out, encoding="utf-8")]
+        fl_gens = [r for r in flines[1:] if r.get("kind") == "generation"]
+        assert [r["attrs"]["generation"] for r in fl_gens] == [0, 1, 2, 3, 4]
+        assert any(r.get("name") == "checkpoint" for r in flines[1:])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2-worker fleet, injected stall → 503 → requeue → recovery
+# ---------------------------------------------------------------------------
+
+
+class OneMax(Individual):
+    """Cheap deterministic fitness: count of set bits."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+def _spawn_workers(port, injector=None):
+    """Two in-process workers; w0 optionally fault-injected."""
+    from gentun_tpu.distributed import GentunClient
+
+    stops = []
+    for i, inj in enumerate([injector, None]):
+        stop = threading.Event()
+        threading.Thread(
+            target=lambda s=stop, wid=f"w{i}", fi=inj: GentunClient(
+                OneMax, *DATA, host="127.0.0.1", port=port,
+                heartbeat_interval=0.2, reconnect_delay=0.1,
+                worker_id=wid, fault_injector=fi,
+            ).work(stop_event=s),
+            daemon=True,
+        ).start()
+        stops.append(stop)
+    return stops
+
+
+def _hang_injector(duration):
+    """w0 stalls its second eval batch; the hang also suppresses its
+    heartbeats, but the fleet tests pin heartbeat_timeout=30 so the
+    reaper stays out of the story — only the watchdog may act."""
+    from gentun_tpu.distributed import FaultInjector, FaultPlan, FaultSpec
+
+    return FaultInjector(FaultPlan([
+        FaultSpec(hook="worker_pre_eval", kind="hang", at=1, duration=duration),
+    ], seed=2026))
+
+
+class TestEndToEndOps:
+    def test_stall_flips_healthz_then_recovers(self, tmp_path):
+        """Acceptance: /healthz 200 on a healthy 2-worker fleet, 503
+        within the watchdog window after an injected worker stall, and
+        back to 200 once the stalled job finally lands (no requeue —
+        the flag persists for the whole hang, so the poller reliably
+        observes both transitions)."""
+        from gentun_tpu.distributed import DistributedPopulation
+
+        srv = start_ops_server(port=0, flight_path=str(tmp_path / "flight.jsonl"))
+        codes, statusz_mid = [], {}
+        stop_poll = threading.Event()
+
+        def _poll():
+            while not stop_poll.is_set():
+                codes.append(_get(srv.url + "/healthz")[0])
+                snap = json.loads(_get(srv.url + "/statusz")[1])
+                if "engine" in snap and "fleet" in snap:
+                    statusz_mid.update(snap)  # keep a mid-run fleet view
+                time.sleep(0.05)
+
+        with DistributedPopulation(
+            OneMax, size=8, seed=6, port=0, heartbeat_timeout=30.0,
+            straggler_floor_s=0.75, straggler_k=4.0,
+        ) as pop:
+            _, port = pop.broker_address
+            stops = _spawn_workers(port, injector=_hang_injector(3.0))
+            poller = threading.Thread(target=_poll, daemon=True)
+            poller.start()
+            try:
+                ga = GeneticAlgorithm(pop, seed=6)
+                best = ga.run(2)
+                assert best.get_fitness() > 0
+                # fleet quiescent again: healthz must have healed
+                final_code, final_body, _ = _get(srv.url + "/healthz")
+            finally:
+                stop_poll.set()
+                poller.join(timeout=5.0)
+                for s in stops:
+                    s.set()
+
+            # -- the stall surfaced, then healed -------------------------
+            assert 503 in codes, f"stall never flipped healthz: {codes}"
+            assert final_code == 200, json.loads(final_body)
+
+            # -- watchdog counted the hung worker's job -------------------
+            snap = get_registry().snapshot()
+            dets = [c for c in snap["counters"]
+                    if c["name"] == "stragglers_detected_total"]
+            assert sum(c["value"] for c in dets) >= 1
+            assert {c["labels"]["worker"] for c in dets} == {"w0"}
+
+            # -- mid-run statusz carried both providers -------------------
+            assert statusz_mid, "poller never saw a mid-run statusz"
+            assert statusz_mid["engine"]["mode"] == "generational"
+            assert statusz_mid["engine"]["trace_id"]  # live run span id
+            fleet = statusz_mid["fleet"]
+            assert fleet["straggler_requeue"] is False
+            assert {w["worker_id"] for w in fleet["workers"]} <= {"w0", "w1"}
+
+            # -- /metrics is scrape-ready exposition text -----------------
+            code, body, ctype = _get(srv.url + "/metrics")
+            assert code == 200 and "version=0.0.4" in ctype
+            text = body.decode("utf-8")
+            assert 'stragglers_detected_total{worker="w0"}' in text
+            for line in text.splitlines():
+                assert line.startswith("#") or " " in line  # name value pairs
+
+            # -- the straggler left a telemetry event in the flight ring --
+            code, body, _ = _get(srv.url + "/debugz/flight")
+            assert code == 200
+            flines = [json.loads(l) for l in body.decode("utf-8").splitlines()]
+            assert "straggler_detected" in {r.get("name") for r in flines[1:]}
+
+    def test_straggler_requeued_to_healthy_worker(self):
+        """Opt-in requeue: the flagged job is pulled from the hung worker,
+        redispatched, the counters/events record it, and the search
+        completes with zero leaked broker state."""
+        from gentun_tpu.distributed import DistributedPopulation
+
+        health_mod.enable()
+        spans_mod.enable()
+        sink = _ListSink()
+        spans_mod.set_run_sink(sink)
+        with DistributedPopulation(
+            OneMax, size=8, seed=6, port=0, heartbeat_timeout=30.0,
+            straggler_floor_s=0.5, straggler_k=4.0, straggler_requeue=True,
+        ) as pop:
+            _, port = pop.broker_address
+            stops = _spawn_workers(port, injector=_hang_injector(2.5))
+            try:
+                ga = GeneticAlgorithm(pop, seed=6)
+                best = ga.run(2)
+            finally:
+                for s in stops:
+                    s.set()
+            assert best.get_fitness() > 0
+            leaked = pop.broker.outstanding()
+            assert all(v == 0 for v in leaked.values()), leaked
+
+        snap = get_registry().snapshot()
+        by_name = {}
+        for c in snap["counters"]:
+            by_name.setdefault(c["name"], []).append(c)
+        assert sum(c["value"] for c in by_name["stragglers_detected_total"]) >= 1
+        assert sum(c["value"] for c in by_name["stragglers_requeued_total"]) >= 1
+        (req,) = by_name["stragglers_requeued_total"]
+        assert req["labels"] == {"worker": "w0"}  # pulled from the hung worker
+
+        names = [r["name"] for r in sink.records if r.get("type") == "event"]
+        assert "straggler_detected" in names
+        assert "straggler_requeued" in names
