@@ -35,6 +35,21 @@ deterministic completion order (one in-flight slot, or a single capacity-1
 worker) the whole trajectory is a pure function of the seed, checkpoints
 included.  The generational mode is untouched: ``GeneticAlgorithm`` remains
 the default and stays bit-identical.
+
+Multi-fidelity (``fidelity_ladder=``): asynchronous successive halving
+(ASHA, Li et al. 2020) layered onto the same completion loop.  The ladder
+is a list of ``additional_parameters`` overlays, rung 0 (cheap proxy
+schedule) to the top (full schedule); every child is dispatched at rung 0
+and, once a rung has seen ``eta`` completions per promotion slot, its
+top-``1/eta`` ring members are promoted — a *promotion probe* (same
+genes, next rung's overlay) rides the ordinary dispatch path, so rungs
+never barrier and a straggling promotion never blocks breeding.  When a
+probe lands, the member's fitness is replaced in place by the
+higher-fidelity measurement (selection therefore always compares each
+member at its highest completed rung) and the proxy/full results live
+under disjoint fitness-cache keys (the overlay is part of the key).
+``fidelity_ladder=None`` (default) is the pre-ladder engine, bit for bit.
+See DISTRIBUTED.md "Multi-fidelity evolution".
 """
 
 from __future__ import annotations
@@ -44,7 +59,7 @@ import logging
 import queue as _queue
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -52,7 +67,13 @@ from .individuals import Individual
 from .populations import Population
 from .telemetry import health as _health
 from .telemetry import spans as _tele
-from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
+from .telemetry.registry import get_registry as _get_registry
+from .utils.fitness_store import (
+    FITNESS_PROTOCOL,
+    fidelity_fingerprint,
+    is_serializable_key,
+    tuplify,
+)
 
 __all__ = ["AsyncEvolution"]
 
@@ -60,6 +81,27 @@ logger = logging.getLogger("gentun_tpu")
 
 #: event tuple: (token, fitness-or-None, error-reason-or-None)
 _Event = Tuple[Any, Optional[float], Optional[str]]
+
+
+class _Work:
+    """One owed evaluation, as the scheduler tracks it.
+
+    ``ind`` is the individual actually shipped (it carries the rung's
+    config overlay).  ``is_member`` marks unevaluated RING members being
+    measured in place (the pre-ladder cohort path).  ``target`` — when not
+    None — is the ring member this result belongs to: promotion probes and
+    ladder-mode cohort probes evaluate a config-overlaid twin of the
+    member, then write the fitness back to the member itself.
+    """
+
+    __slots__ = ("ind", "is_member", "rung", "target")
+
+    def __init__(self, ind: Individual, is_member: bool, rung: int = 0,
+                 target: Optional[Individual] = None):
+        self.ind = ind
+        self.is_member = is_member
+        self.rung = int(rung)
+        self.target = target
 
 
 class _LocalEvaluator:
@@ -208,6 +250,15 @@ class AsyncEvolution:
     job_timeout:
         Max seconds to wait for ANY completion before raising — ``None``
         waits forever (the generational default).
+    fidelity_ladder:
+        ``None`` (default): single-fidelity, the pre-ladder engine bit for
+        bit.  Otherwise a sequence of ``additional_parameters`` overlays,
+        rung 0 (proxy) → last (full schedule; ``{}`` means "the
+        population's own config").  Children dispatch at rung 0; the
+        top-``1/eta`` of each rung promote asynchronously.
+    eta:
+        ASHA reduction factor: one promotion slot per ``eta`` completions
+        at a rung.  Ignored without a ladder.
     """
 
     def __init__(
@@ -218,12 +269,35 @@ class AsyncEvolution:
         seed: Optional[int] = None,
         checkpoint_every: int = 8,
         job_timeout: Optional[float] = None,
+        fidelity_ladder: Optional[Sequence[Mapping[str, Any]]] = None,
+        eta: int = 4,
     ):
         self.population = population
         self.tournament_size = int(tournament_size)
         self.max_in_flight = None if max_in_flight is None else max(1, int(max_in_flight))
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.job_timeout = job_timeout
+        if fidelity_ladder is not None:
+            ladder = [dict(r) for r in fidelity_ladder]
+            if not ladder:
+                raise ValueError("fidelity_ladder must name at least one rung "
+                                 "(use None for single-fidelity)")
+            if int(eta) < 2:
+                raise ValueError(f"eta must be >= 2 (got {eta}): promoting "
+                                 "every completion is not a ladder")
+            self._ladder: Optional[List[Dict[str, Any]]] = ladder
+        else:
+            self._ladder = None
+        self.eta = int(eta)
+        #: per-rung fitnesses of every completion at that rung, in
+        #: completion order — the ASHA promotion quota reads this, so it is
+        #: serialized for deterministic resume.
+        self._rung_completions: List[List[float]] = (
+            [[] for _ in (self._ladder or ())])
+        #: per-rung ever-best (copies, like ``best``); ``best`` itself is
+        #: the best at the HIGHEST rung with any completion, because proxy
+        #: and full-schedule fitnesses are not comparable numbers.
+        self._best_by_rung: Dict[int, Individual] = {}
         self.rng = np.random.default_rng(seed) if seed is not None else population.rng
         self.pop_size = len(population)
         self.completed = 0
@@ -236,17 +310,19 @@ class AsyncEvolution:
         self._checkpointer = None
         self._fault_injector = None
         self._last_ckpt = 0
-        # Scheduler state (also serialized): children bred and dispatched
-        # but not yet completed, in dispatch order — the piece a resumed
-        # run must re-dispatch to continue the same trajectory.
-        self._open_children: Dict[int, Individual] = {}
-        self._restored_in_flight: List[Individual] = []
+        # Scheduler state (also serialized): children bred/promotions
+        # decided and dispatched but not yet completed, in dispatch order —
+        # the piece a resumed run must re-dispatch to continue the same
+        # trajectory.  Values are _Work records keyed by id(work.ind).
+        self._open_children: Dict[int, _Work] = {}
+        self._restored_in_flight: List[_Work] = []
         # Run-local maps (rebuilt by run()).
-        self._queue: List[Tuple[Individual, bool]] = []
-        self._inflight: Dict[Any, Tuple[Individual, bool]] = {}
-        self._followers: Dict[Any, List[Tuple[Individual, bool]]] = {}
+        self._queue: List[_Work] = []
+        self._inflight: Dict[Any, _Work] = {}
+        self._followers: Dict[Any, List[_Work]] = {}
         self._key_to_token: Dict[Any, Any] = {}
         self._cap = 1
+        self._evaluator = None
 
     # -- hooks (same contract as GeneticAlgorithm) -------------------------
 
@@ -293,6 +369,7 @@ class AsyncEvolution:
                 logger.info("resumed async search at %d completion(s)", self.completed)
         budget = int(max_evaluations)
         evaluator = self._make_evaluator()
+        self._evaluator = evaluator
         cap = self.max_in_flight
         if cap is None:
             cap = evaluator.default_capacity()
@@ -300,9 +377,21 @@ class AsyncEvolution:
         self._last_ckpt = self.completed
         # Everything whose evaluation is owed but not running: unevaluated
         # ring members first (initial cohort / in-flight-at-kill members),
-        # then checkpointed in-flight children in dispatch order.
-        self._queue = [(ind, True) for ind in self.population if not ind.fitness_evaluated]
-        self._queue += [(ind, False) for ind in self._restored_in_flight]
+        # then checkpointed in-flight children/promotions in dispatch
+        # order.  With a ladder, cohort members are measured through a
+        # rung-0 probe (same genes, proxy overlay) so the whole search —
+        # founders included — starts at proxy fidelity.
+        self._queue = []
+        for ind in self.population:
+            if ind.fitness_evaluated:
+                continue
+            if self._ladder is None:
+                self._queue.append(_Work(ind, True))
+            else:
+                probe = self.population.spawn(
+                    genes=ind.get_genes(), additional_parameters=self._ladder[0])
+                self._queue.append(_Work(probe, False, rung=0, target=ind))
+        self._queue += self._restored_in_flight
         self._restored_in_flight = []
         self._inflight = {}
         self._followers = {}
@@ -346,10 +435,16 @@ class AsyncEvolution:
                     # results are unwanted — withdraw instead of waiting.
                     evaluator.cancel(leftover)
                     for token in leftover:
-                        ind, _ = self._inflight.pop(token)
-                        self._open_children.pop(id(ind), None)
+                        work = self._inflight.pop(token)
+                        self._open_children.pop(id(work.ind), None)
+                        if work.target is not None:
+                            work.target._promo_pending = False
                     self._key_to_token = {}
                     self._followers = {}
+                for work in self._queue:
+                    if work.target is not None:
+                        work.target._promo_pending = False
+                self._evaluator = None
                 evaluator.close()
         if self.best is None:
             raise RuntimeError("no evaluation ever completed successfully")
@@ -364,7 +459,7 @@ class AsyncEvolution:
         (``telemetry/health.py`` status provider; snapshot reads only —
         ``self.best`` is replaced wholesale, never mutated in place)."""
         best = self.best
-        return {
+        status = {
             "mode": "async",
             "completed": self.completed,
             "dispatched": self.dispatched,
@@ -374,6 +469,24 @@ class AsyncEvolution:
             "best_fitness": best.get_fitness() if best is not None else None,
             "trace_id": getattr(self, "_run_trace_id", None),
         }
+        if self._ladder is not None:
+            # Per-rung ladder snapshot (docs/OBSERVABILITY.md): how far up
+            # the fidelity ladder the search has climbed, at a glance.
+            pending = [0] * len(self._ladder)
+            for w in list(self._queue) + list(self._inflight.values()):
+                if w.target is not None and w.rung < len(pending):
+                    pending[w.rung] += 1
+            status["rungs"] = [
+                {
+                    "rung": r,
+                    "completions": len(self._rung_completions[r]),
+                    "best_fitness": (self._best_by_rung[r].get_fitness()
+                                     if r in self._best_by_rung else None),
+                    "probes_pending": pending[r],
+                }
+                for r in range(len(self._ladder))
+            ]
+        return status
 
     # -- internals ---------------------------------------------------------
 
@@ -389,7 +502,27 @@ class AsyncEvolution:
         with _tele.span("reproduce"):
             mother = self.select_parent()
             father = self.select_parent()
-            return mother.reproduce(father, self.rng)
+            child = mother.reproduce(father, self.rng)
+            if self._ladder is not None:
+                # Every child enters the ladder at the proxy rung: same
+                # genes, rung-0 overlay (spawn with explicit genes draws no
+                # randomness, so the trajectory stays seed-pure).
+                child = self.population.spawn(
+                    genes=child.get_genes(),
+                    additional_parameters=self._ladder[0])
+            return child
+
+    def _tag_fidelity(self, work: _Work) -> None:
+        """Stamp the wire fidelity tag on an outgoing individual (OPTIONAL
+        per-job ``fidelity`` field, see ``distributed/protocol.py``) —
+        workers cross-check it against the shipped config before training."""
+        if self._ladder is None:
+            return
+        work.ind._fidelity_tag = {
+            "v": 1,
+            "rung": work.rung,
+            "fingerprint": fidelity_fingerprint(work.ind.additional_parameters),
+        }
 
     def _refill(self, evaluator, budget: int) -> None:
         """Top the in-flight set back up to the target, breeding as needed.
@@ -400,108 +533,276 @@ class AsyncEvolution:
         fitness store) completes instantly; a child identical to an
         in-flight job becomes its follower.  Neither occupies a slot, so
         the loop keeps breeding until real work fills the capacity or the
-        budget is spent.
+        budget is spent.  Promotion probes queued by completions take
+        strict priority over fresh breeding (they are the scarce
+        high-fidelity work the ladder exists to schedule).
         """
-        to_submit: List[Tuple[Individual, bool, Any]] = []
+        tele = _tele.enabled()
+        to_submit: List[Tuple[_Work, Any]] = []
         while (self.dispatched < budget
                and len(self._inflight) + len(to_submit) < self._cap):
             if self._queue:
-                ind, is_member = self._queue.pop(0)
+                work = self._queue.pop(0)
             elif self._can_breed():
-                ind, is_member = self._breed(), False
+                work = _Work(self._breed(), False)
             else:
                 break  # nothing evaluated yet: wait for the cohort
             self.dispatched += 1
-            key = self.population._safe_cache_key(ind)
+            key = self.population._safe_cache_key(work.ind)
             cached = self.population.fitness_cache.get(key) if key is not None else None
             if cached is not None:
-                self._complete(ind, float(cached), is_member, cached=True)
+                if tele:
+                    _get_registry().counter(
+                        "fitness_cache_hits_total", rung=str(work.rung)).inc()
+                self._complete(work, float(cached), cached=True)
                 continue
+            if tele:
+                _get_registry().counter(
+                    "fitness_cache_misses_total", rung=str(work.rung)).inc()
             token = self._key_to_token.get(key) if key is not None else None
             if token is not None:
-                self._followers.setdefault(token, []).append((ind, is_member))
-                if not is_member:
-                    self._open_children[id(ind)] = ind
+                self._followers.setdefault(token, []).append(work)
+                self._track_open(work)
                 continue
-            to_submit.append((ind, is_member, key))
+            to_submit.append((work, key))
         if to_submit:
-            tokens = evaluator.submit([ind for ind, _, _ in to_submit])
-            for token, (ind, is_member, key) in zip(tokens, to_submit):
-                self._inflight[token] = (ind, is_member)
+            for work, _ in to_submit:
+                self._tag_fidelity(work)
+            tokens = evaluator.submit([w.ind for w, _ in to_submit])
+            for token, (work, key) in zip(tokens, to_submit):
+                self._inflight[token] = work
                 if key is not None:
                     self._key_to_token[key] = token
-                if not is_member:
-                    self._open_children[id(ind)] = ind
+                self._track_open(work)
+
+    def _track_open(self, work: _Work) -> None:
+        """Record dispatched-but-unfinished work the checkpoint must carry.
+
+        Children and PROMOTION probes are serialized (the breeding RNG
+        draws / promotion decision behind them are already spent, so a
+        resumed run must re-dispatch exactly these).  Ladder-mode COHORT
+        probes are not: an unevaluated ring member re-probes from the ring
+        state alone.
+        """
+        if work.is_member:
+            return
+        if work.target is not None and not work.target.fitness_evaluated:
+            return  # cohort probe — reconstructed from the ring on resume
+        self._open_children[id(work.ind)] = work
 
     def _on_event(self, token, fitness: Optional[float], error: Optional[str]) -> None:
-        entry = self._inflight.pop(token, None)
-        if entry is None:
+        work = self._inflight.pop(token, None)
+        if work is None:
             return  # cancelled/stale
-        ind, is_member = entry
-        key = self.population._safe_cache_key(ind)
+        key = self.population._safe_cache_key(work.ind)
         if key is not None and self._key_to_token.get(key) is token:
             del self._key_to_token[key]
         followers = self._followers.pop(token, [])
         if error is not None:
-            self._fail(ind, is_member, error)
-            for f_ind, f_member in followers:
-                self._fail(f_ind, f_member, error)
+            self._fail(work, error)
+            for f in followers:
+                self._fail(f, error)
             return
-        self._complete(ind, fitness, is_member)
-        for f_ind, f_member in followers:
-            self._complete(f_ind, fitness, f_member)
+        self._complete(work, fitness)
+        for f in followers:
+            self._complete(f, fitness)
 
-    def _complete(self, ind: Individual, fitness: float, is_member: bool,
-                  cached: bool = False) -> None:
-        """One evaluation finished: membership, cache, best, history."""
+    def _complete(self, work: _Work, fitness: float, cached: bool = False) -> None:
+        """One evaluation finished: membership, cache, best, history,
+        and — with a ladder — the ASHA promotion sweep at this rung."""
+        ind = work.ind
         if not ind.fitness_evaluated:
             ind.set_fitness(fitness)
         key = self.population._safe_cache_key(ind)
         if key is not None and not cached:
             self.population.fitness_cache[key] = float(fitness)
         self._open_children.pop(id(ind), None)
-        if not is_member:
+        if work.target is not None:
+            # Probe landing: the measurement belongs to the ring member.
+            # A promotion REPLACES the member's lower-rung fitness in
+            # place, so tournament selection always compares each member
+            # at its highest completed rung.
+            member = work.target
+            member.set_fitness(float(fitness))
+            member._rung = work.rung
+            member._promo_pending = False
+        elif not work.is_member:
             # Steady-state transition: child in (youngest), oldest out.
+            if self._ladder is not None:
+                ind._rung = work.rung
             self.population.insert(ind)
             if len(self.population) > self.pop_size:
-                self.population.evict_oldest()
-        if self.best is None:
-            better = True
-        elif self.population.maximize:
-            better = fitness > self.best.get_fitness()
-        else:
-            better = fitness < self.best.get_fitness()
-        if better:
-            self.best = ind.copy()  # keeps the fitness
+                evicted = self.population.evict_oldest()
+                if evicted is not None:
+                    self._cancel_promotions_for(evicted)
+        elif self._ladder is not None:
+            ind._rung = work.rung
+        self._update_best(work, float(fitness))
         self.completed += 1
-        self.history.append({
+        entry = {
             "completed": self.completed,
             "fitness": float(fitness),
             "best_fitness": self.best.get_fitness(),
             "in_flight": len(self._inflight),
             "cached": bool(cached),
-        })
+        }
+        if self._ladder is not None:
+            entry["rung"] = work.rung
+            entry["promotion"] = work.target is not None and work.rung > 0
+            self._rung_completions[work.rung].append(float(fitness))
+            self._maybe_promote(work.rung)
+        self.history.append(entry)
 
-    def _fail(self, ind: Individual, is_member: bool, reason: str) -> None:
+    def _update_best(self, work: _Work, fitness: float) -> None:
+        maximize = self.population.maximize
+
+        def _better(f, incumbent):
+            if incumbent is None:
+                return True
+            inc = incumbent.get_fitness()
+            return f > inc if maximize else f < inc
+
+        if self._ladder is None:
+            if _better(fitness, self.best):
+                self.best = work.ind.copy()  # keeps the fitness
+            return
+        # Ladder mode: proxy and full-schedule fitnesses are different
+        # quantities — track a best per rung, and expose the best at the
+        # highest rung that has completed anything as THE best.
+        if _better(fitness, self._best_by_rung.get(work.rung)):
+            b = work.ind.copy()
+            b.set_fitness(fitness)
+            b._rung = work.rung
+            self._best_by_rung[work.rung] = b
+        self.best = self._best_by_rung[max(self._best_by_rung)]
+
+    def _maybe_promote(self, rung: int) -> None:
+        """ASHA promotion sweep after a completion at ``rung``: the rung
+        owns ``completions // eta`` promotion slots, of which the sweep
+        fills the still-open ones — best ring member first, and only with
+        members whose fitness makes the top-``quota`` cut.  Filling at
+        most the open slots is what keeps the rung sizes geometric
+        (≈ 1/eta of the rung below); the cut alone would over-promote,
+        because ring turnover keeps producing members above a historical
+        threshold.  No barrier: the sweep never waits for stragglers, it
+        only reads what has already completed (Li et al. 2020, §3.1)."""
+        if self._ladder is None or rung + 1 >= len(self._ladder):
+            return
+        vals = self._rung_completions[rung]
+        quota = len(vals) // self.eta
+        if quota <= 0:
+            return
+        # Promotions already spent from this rung: completions at rung+1
+        # (everything above rung 0 got there only by promotion) plus probes
+        # still queued or training.  Derived, not counted — so a cancelled
+        # or failed probe refunds its slot automatically and a resumed
+        # checkpoint reconstructs the same number from the same state.
+        spent = len(self._rung_completions[rung + 1]) + sum(
+            1 for w in list(self._queue) + list(self._inflight.values())
+            if w.target is not None and w.rung == rung + 1)
+        open_slots = quota - spent
+        if open_slots <= 0:
+            return
+        cut = sorted(vals, reverse=self.population.maximize)[quota - 1]
+        candidates = []
+        for member in list(self.population):
+            if getattr(member, "_rung", None) != rung:
+                continue
+            if getattr(member, "_promo_pending", False):
+                continue
+            if getattr(member, "_promo_failed_rung", None) == rung + 1:
+                continue  # its probe failed permanently — no retry loop
+            if not member.fitness_evaluated:
+                continue
+            f = member.get_fitness()
+            if (f < cut) if self.population.maximize else (f > cut):
+                continue
+            candidates.append(member)
+        # Best-first within the open slots (stable sort → ring order breaks
+        # ties deterministically).
+        candidates.sort(key=lambda m: m.get_fitness(),
+                        reverse=self.population.maximize)
+        tele = _tele.enabled()
+        for member in candidates[:open_slots]:
+            probe = self.population.spawn(
+                genes=member.get_genes(),
+                additional_parameters=self._ladder[rung + 1])
+            member._promo_pending = True
+            self._queue.append(_Work(probe, False, rung=rung + 1, target=member))
+            if tele:
+                _get_registry().counter(
+                    "promotions_total", rung=str(rung + 1)).inc()
+
+    def _cancel_promotions_for(self, member: Individual) -> None:
+        """Withdraw any queued or in-flight promotion probe targeting an
+        evicted member: its result could no longer join the ring, and an
+        abandoned in-flight probe would leak a ``jobs_in_flight`` slot.
+        The broker's cancel restores the worker's credit; the dispatch
+        count is retracted so the budget still measures completions."""
+        if self._ladder is None or not getattr(member, "_promo_pending", False):
+            return
+        # Queued probes were never dispatched — dropping them costs nothing.
+        self._queue = [w for w in self._queue if w.target is not member]
+        stale = [tok for tok, w in self._inflight.items() if w.target is member]
+        for tok in stale:
+            w = self._inflight.pop(tok)
+            key = self.population._safe_cache_key(w.ind)
+            if key is not None and self._key_to_token.get(key) is tok:
+                del self._key_to_token[key]
+            self._open_children.pop(id(w.ind), None)
+            self.dispatched -= 1  # retracted, never completing
+            for f in self._followers.pop(tok, []):
+                # Followers ride another token's evaluation; with it
+                # cancelled they go back to the queue (their dispatch is
+                # retracted too — they re-count when re-popped).
+                self.dispatched -= 1
+                self._open_children.pop(id(f.ind), None)
+                if f.target is not member:
+                    self._queue.insert(0, f)
+        if stale and self._evaluator is not None:
+            self._evaluator.cancel(stale)
+            if _tele.enabled():
+                _get_registry().counter(
+                    "promotions_cancelled_total").inc(len(stale))
+        member._promo_pending = False
+
+    def _fail(self, work: _Work, reason: str) -> None:
         """A permanently failed evaluation consumes budget and breeds a
-        replacement (via the next refill) but never joins the ring — and a
-        failed MEMBER leaves it, so aging eviction never has to step over a
-        corpse."""
+        replacement (via the next refill) but never joins the ring — a
+        failed MEMBER leaves it, so aging eviction never has to step over
+        a corpse, and a failed PROMOTION probe leaves its member exactly
+        as it was (lower-rung fitness intact, marked so the ladder never
+        retries the same doomed promotion)."""
         logger.warning("async evaluation failed permanently: %s", reason)
+        ind = work.ind
         self._open_children.pop(id(ind), None)
-        if is_member:
+        if work.target is not None:
+            work.target._promo_pending = False
+            if work.target.fitness_evaluated:
+                work.target._promo_failed_rung = work.rung
+            else:
+                # A failed COHORT probe: the member never got a fitness at
+                # all — it leaves the ring like any failed member would.
+                try:
+                    self.population.individuals.remove(work.target)
+                except ValueError:  # pragma: no cover - defensive
+                    pass
+        elif work.is_member:
             try:
                 self.population.individuals.remove(ind)
             except ValueError:  # pragma: no cover - defensive
                 pass
         self.completed += 1
-        self.history.append({
+        entry = {
             "completed": self.completed,
             "fitness": None,
             "best_fitness": None if self.best is None else self.best.get_fitness(),
             "in_flight": len(self._inflight),
             "failed": True,
-        })
+        }
+        if self._ladder is not None:
+            entry["rung"] = work.rung
+        self.history.append(entry)
 
     def _boundary(self) -> None:
         """Checkpoint (and fire the chaos boundary hook) every
@@ -519,14 +820,60 @@ class AsyncEvolution:
 
     # -- (de)serialization state for checkpoint/resume ---------------------
 
+    def _member_index(self, member: Individual) -> Optional[int]:
+        for i, ind in enumerate(self.population.individuals):
+            if ind is member:
+                return i
+        return None
+
+    def _work_state(self, w: _Work) -> Optional[Dict[str, Any]]:
+        """One laddered in-flight/queued checkpoint entry, or None for a
+        promotion whose member already left the ring (eviction cancels
+        those — nothing to resume)."""
+        entry: Dict[str, Any] = {
+            "genes": w.ind.get_genes(),
+            "rung": w.rung,
+            "kind": "child" if w.target is None else "promotion",
+        }
+        if w.target is not None:
+            idx = self._member_index(w.target)
+            if idx is None:  # pragma: no cover - eviction cancels these
+                return None
+            entry["member_index"] = idx
+        return entry
+
     def state_dict(self) -> Dict[str, Any]:
         fitness_cache = [
             [k, v]
             for k, v in self.population.fitness_cache.items()
             if is_serializable_key(k)
         ]
-        open_children = [ind.get_genes() for ind in self._open_children.values()]
-        return {
+        if self._ladder is None:
+            # Ladderless: the exact v2 in-flight shape (a list of genes).
+            open_children: List[Any] = [
+                w.ind.get_genes() for w in self._open_children.values()]
+        else:
+            # v3: enough to resume a promotion AS a promotion — the rung,
+            # and which ring member the probe reports to.
+            open_children = []
+            for w in self._open_children.values():
+                entry = self._work_state(w)
+                if entry is not None:
+                    open_children.append(entry)
+            # Decided-but-undispatched work (the queue): promotion probes
+            # and requeued children waiting for an in-flight slot.  Cohort
+            # probes are NOT serialized — their members are unevaluated in
+            # the ring, so ``run()`` reconstructs them — but a queued
+            # promotion dropped here would silently demote its member on
+            # resume and diverge from the uninterrupted trajectory.
+            queued = []
+            for w in self._queue:
+                if w.target is not None and not w.target.fitness_evaluated:
+                    continue  # cohort probe: rebuilt from the ring
+                entry = self._work_state(w)
+                if entry is not None:
+                    queued.append(entry)
+        state = {
             "algorithm": "AsyncEvolution",
             "fitness_protocol": FITNESS_PROTOCOL,
             "fitness_cache": fitness_cache,
@@ -548,8 +895,7 @@ class AsyncEvolution:
                 "mutation_rate": self.population.mutation_rate,
                 "additional_parameters": self.population.additional_parameters,
                 "individuals": [
-                    {"genes": ind.get_genes(), "fitness": ind._fitness}
-                    for ind in self.population
+                    self._member_state(ind) for ind in self.population
                 ],
             },
             # Children bred-but-uncompleted, in dispatch order: a resumed
@@ -557,6 +903,25 @@ class AsyncEvolution:
             # produced them are already consumed in rng_state).
             "in_flight": open_children,
         }
+        if self._ladder is not None:
+            state["queued"] = queued
+            state["ladder"] = self._ladder
+            state["eta"] = self.eta
+            state["rung_completions"] = self._rung_completions
+            state["best_by_rung"] = [
+                {"rung": r, "genes": b.get_genes(), "fitness": b.get_fitness()}
+                for r, b in sorted(self._best_by_rung.items())
+            ]
+        return state
+
+    def _member_state(self, ind: Individual) -> Dict[str, Any]:
+        entry: Dict[str, Any] = {"genes": ind.get_genes(), "fitness": ind._fitness}
+        if self._ladder is not None:
+            entry["rung"] = getattr(ind, "_rung", 0)
+            failed = getattr(ind, "_promo_failed_rung", None)
+            if failed is not None:
+                entry["promo_failed_rung"] = failed
+        return entry
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         algo = state.get("algorithm")
@@ -590,11 +955,27 @@ class AsyncEvolution:
                 "the resumed search re-measures instead of mixing "
                 "incomparable measurements", proto, FITNESS_PROTOCOL,
             )
+        # Ladder state (schema v3).  The checkpoint's ladder wins over the
+        # constructor's, like every other serialized knob — a resumed run
+        # continues the SAME search, not a reconfigured one.
+        ladder = state.get("ladder")
+        if ladder is not None:
+            self._ladder = [dict(r) for r in ladder]
+            self.eta = int(state.get("eta", self.eta))
+            self._rung_completions = [
+                [float(v) for v in rung]
+                for rung in state.get("rung_completions",
+                                      [[] for _ in self._ladder])
+            ]
         individuals = []
         for ind_state in pop_state["individuals"]:
             ind = self.population.spawn(genes=ind_state["genes"])
             if ind_state["fitness"] is not None and proto_ok:
                 ind.set_fitness(ind_state["fitness"])
+                if self._ladder is not None:
+                    ind._rung = int(ind_state.get("rung", 0))
+            if ind_state.get("promo_failed_rung") is not None:
+                ind._promo_failed_rung = int(ind_state["promo_failed_rung"])
             individuals.append(ind)
         self.population.individuals = individuals
         self.population.fitness_cache = {
@@ -607,7 +988,41 @@ class AsyncEvolution:
             self.best = b
         else:
             self.best = None
-        self._restored_in_flight = [
-            self.population.spawn(genes=g) for g in state.get("in_flight", [])
-        ]
+        self._best_by_rung = {}
+        if self._ladder is not None and proto_ok:
+            for entry in state.get("best_by_rung", []):
+                r = int(entry["rung"])
+                overlay = self._ladder[min(r, len(self._ladder) - 1)]
+                b = self.population.spawn(
+                    genes=entry["genes"], additional_parameters=overlay)
+                b.set_fitness(entry["fitness"])
+                b._rung = r
+                self._best_by_rung[r] = b
+            if self._best_by_rung:
+                self.best = self._best_by_rung[max(self._best_by_rung)]
+        self._restored_in_flight = []
+        # In-flight first, then the undispatched queue — the original
+        # dispatch order, so the resumed trajectory replays it.
+        for entry in list(state.get("in_flight", [])) + list(state.get("queued", [])):
+            if self._ladder is None:
+                # v2 shape: the entry IS the genes dict of a rung-0 child.
+                self._restored_in_flight.append(
+                    _Work(self.population.spawn(genes=entry), False))
+                continue
+            if "kind" not in entry:  # v2 file resumed WITH a ladder ctor
+                entry = {"genes": entry, "rung": 0, "kind": "child"}
+            rung = min(int(entry.get("rung", 0)), len(self._ladder) - 1)
+            overlay = self._ladder[rung]
+            probe = self.population.spawn(
+                genes=entry["genes"], additional_parameters=overlay)
+            target = None
+            if entry.get("kind") == "promotion":
+                idx = entry.get("member_index")
+                if idx is not None and 0 <= int(idx) < len(individuals):
+                    target = individuals[int(idx)]
+                    target._promo_pending = True
+                else:  # pragma: no cover - defensive
+                    continue
+            self._restored_in_flight.append(
+                _Work(probe, False, rung=rung, target=target))
         self._last_ckpt = self.completed

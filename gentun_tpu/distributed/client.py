@@ -592,6 +592,18 @@ class GentunClient:
             individuals = []
             ok_jobs = []
             for job in group:
+                # OPTIONAL per-job fidelity tag (protocol.py "Multi-fidelity
+                # field"): validated BEFORE the individual is built, so an
+                # unknown or mislabeled tag answers with a structured fail
+                # frame — one lost job the master retries or re-routes — and
+                # never a poison-genome crash or, worse, a wrong-schedule
+                # fitness silently poisoning a rung.  Tagless jobs (old
+                # masters) skip the check entirely.
+                reason = self._check_fidelity(job)
+                if reason is not None:
+                    logger.warning("job %s rejected: %s", job["job_id"], reason)
+                    self._try_send_fail(job["job_id"], reason)
+                    continue
                 try:
                     ind = self.species(
                         x_train=self.x_train,
@@ -670,6 +682,34 @@ class GentunClient:
                 for job in ok_jobs:
                     self._try_send_fail(job["job_id"], f"evaluate: {e!r}")
         self._last_batch_end = time.monotonic()
+
+    @staticmethod
+    def _check_fidelity(job: Dict[str, Any]) -> Optional[str]:
+        """None when the job's fidelity tag is absent or checks out;
+        otherwise the structured-``fail`` reason string.
+
+        The tag's fingerprint must match what this worker computes from
+        the SHIPPED ``additional_parameters`` — a mismatch means the
+        master's rung label and the training schedule in the payload
+        disagree (a mixed-version fleet, or a relabeled overlay), and
+        training it would file a wrong-fidelity fitness under the rung's
+        cache key.  Unknown tag versions are refused the same way rather
+        than guessed at.
+        """
+        tag = job.get("fidelity")
+        if tag is None:
+            return None  # old master — pre-ladder protocol, evaluate as-is
+        if not isinstance(tag, dict) or tag.get("v") != 1:
+            return (f"fidelity: unknown tag version {tag!r}; this worker "
+                    f"understands v=1 — upgrade the fleet together")
+        from ..utils.fitness_store import fidelity_fingerprint
+
+        expected = fidelity_fingerprint(job.get("additional_parameters") or {})
+        if tag.get("fingerprint") != expected:
+            return (f"fidelity: tag fingerprint {tag.get('fingerprint')!r} does "
+                    f"not match the shipped config ({expected}) at rung "
+                    f"{tag.get('rung')} — refusing a mislabeled schedule")
+        return None
 
     def _try_send_fail(self, job_id: str, reason: str) -> None:
         if not self._is_leader:

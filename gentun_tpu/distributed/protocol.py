@@ -42,6 +42,21 @@ old workers and old masters interoperate unchanged):
   ``prefetch_depth=0``) gets exactly the pre-pipelining behavior on
   both ends.
 
+Multi-fidelity field (same OPTIONAL-with-conservative-default convention):
+
+- each ``jobs`` entry may carry ``fidelity`` {v, rung, fingerprint}: the
+  rung this job was dispatched at by a ladder-running master
+  (``AsyncEvolution(fidelity_ladder=...)``) and the
+  ``utils/fitness_store.fidelity_fingerprint`` of the shipped
+  ``additional_parameters``.  Workers that understand it cross-check the
+  fingerprint against the config they are about to train with and reply
+  with a structured ``fail`` frame on mismatch or on an unknown tag
+  version (``v != 1``) — a mislabeled fidelity must lose ONE job loudly,
+  never poison a rung with a wrong-schedule measurement.  A tagless job
+  (old master) evaluates exactly as before, and an old worker ignores
+  the field entirely — the fitness-cache keys on the master still keep
+  rungs disjoint, the tag only adds fleet-side detection.
+
 Telemetry fields (``gentun_tpu/telemetry``, docs/OBSERVABILITY.md) — both
 OPTIONAL and only present when tracing is enabled on the sending side;
 receivers that don't understand them ignore them, so mixed

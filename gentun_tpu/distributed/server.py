@@ -223,6 +223,12 @@ class DistributedPopulation(Population):
                 "genes": ind.get_genes(),
                 "additional_parameters": dict(ind.additional_parameters),
             }
+            # OPTIONAL per-job fidelity tag (protocol.py): stamped by the
+            # multi-fidelity engine so workers can refuse a mislabeled
+            # rung with a structured fail frame instead of training it.
+            fidelity = getattr(ind, "_fidelity_tag", None)
+            if fidelity is not None:
+                payload["fidelity"] = dict(fidelity)
             if ctx is not None:
                 payload["trace"] = ctx
             payloads[job_id] = payload
@@ -412,6 +418,9 @@ class DistributedPopulation(Population):
                 "genes": ind.get_genes(),
                 "additional_parameters": dict(ind.additional_parameters),
             }
+            fidelity = getattr(ind, "_fidelity_tag", None)
+            if fidelity is not None:
+                payloads[job_id]["fidelity"] = dict(fidelity)
             by_id[job_id] = ind
         return payloads, by_id, dup_map, rep_job
 

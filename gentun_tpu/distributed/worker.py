@@ -11,7 +11,13 @@ it consumes jobs until killed:
 
 All model hyperparameters (``additional_parameters``) arrive from the
 master with each job, so the worker needs only its species and its copy of
-the training data — genes in, fitness out (SURVEY.md §1).
+the training data — genes in, fitness out (SURVEY.md §1).  Jobs from a
+multi-fidelity master additionally carry a ``fidelity`` tag
+(``protocol.py``); the client cross-checks it against the shipped config
+and answers an unknown or mislabeled tag with a structured ``fail`` frame
+instead of training a wrong-schedule measurement — a mixed-version fleet
+degrades to per-job refusals, never to silent rung poisoning.  Tagless
+jobs from pre-ladder masters evaluate unchanged.
 
 Multi-host worker (ONE worker owning a whole TPU pod slice, e.g. a
 v5e-32 = 8 hosts × 4 chips — BASELINE config #4): run the same command on

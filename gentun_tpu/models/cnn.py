@@ -412,6 +412,7 @@ def _run_segmented(
     n_train: int,
     n_val_padded: int,
     eval_batch_size: int,
+    warm_keys=None,
 ) -> np.ndarray:
     """Host loop over folds × bounded segments; returns (kfold, P) accs.
 
@@ -493,6 +494,11 @@ def _run_segmented(
             accs.append(acc)
         else:
             accs.append(eval_pop(p, masks, x_full, y_full, vi, vw))
+        if f == 0 and warm_keys is not None:
+            # Deposit BEFORE the carry dies: fold 0's trained params become
+            # the warm-start seed a later higher-rung evaluation of the
+            # same genome inherits (``_warm_bank_deposit``).
+            _warm_bank_deposit(p, warm_keys)
         del p, opt
     # fetch = np.asarray single-process; an all-gather of the pop-sharded
     # accuracies when the mesh spans processes (every host gets the full
@@ -597,6 +603,74 @@ def _init_population_params(model: MaskedGeneticCnn, masks_stacked, input_shape,
         base = jax.random.fold_in(base, domain)
     keys = _content_keys(base, kfold, genome_hashes)
     return _init_fn(model, tuple(input_shape))(keys, masks_stacked)
+
+
+#: Parent→child weight bank for multi-fidelity warm starts (``warm_start``
+#: knob; DISTRIBUTED.md "Multi-fidelity evolution").  Keyed by the 64-bit
+#: genome content hash (both ``_genome_hashes`` words), so a promoted
+#: genome finds exactly ITS lower-rung parameters — never a sibling's —
+#: regardless of batch composition or slot order.  Values are host-numpy
+#: single-slot param trees (the trained fold-0 carry), insertion-ordered
+#: for LRU eviction.  Process-local BY DESIGN: a promotion landing on a
+#: different worker cold-starts, which is always correct (warm start is a
+#: pure speedup, never a correctness dependency), and nothing crosses the
+#: wire — genes in, fitness out stays intact.
+_WARM_BANK: Dict[Tuple[int, int], Any] = {}
+_WARM_BANK_CAP = 64
+
+
+def _warm_bank_deposit(params_f0, hashes) -> None:
+    """Bank each slot's trained fold-0 params, keyed by genome content hash.
+
+    ``params_f0`` leaves are (P, ...) device arrays; fetching them here is
+    the only host transfer the warm-start path adds, and it happens once
+    per evaluation AFTER fold 0's work is already queued — the device keeps
+    training fold 1 while the host copies.
+    """
+    leaves, treedef = jax.tree.flatten(params_f0)
+    host = [np.array(fetch(leaf)) for leaf in leaves]
+    for i in range(len(hashes)):
+        key = (int(hashes[i][0]), int(hashes[i][1]))
+        _WARM_BANK.pop(key, None)
+        _WARM_BANK[key] = jax.tree.unflatten(treedef, [h[i] for h in host])
+    while len(_WARM_BANK) > _WARM_BANK_CAP:
+        del _WARM_BANK[next(iter(_WARM_BANK))]
+
+
+def _warm_start_overlay(params, hashes):
+    """Overlay banked lower-rung params onto fresh inits, where shapes match.
+
+    ``params`` leaves are (kfold, P, ...); a banked slot is copied into its
+    slot across the WHOLE fold axis (each fold still sees an independent
+    dropout/batch stream, only the starting point is shared).  A leaf whose
+    shape or dtype disagrees with the bank (the genome was banked under a
+    different static config) keeps its fresh init — partial inheritance is
+    the contract, matching per-layer shape-compatible transfer.  Returns
+    (params, slots_warmed).
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    host = None
+    warmed = 0
+    for i in range(len(hashes)):
+        key = (int(hashes[i][0]), int(hashes[i][1]))
+        banked = _WARM_BANK.get(key)
+        if banked is None:
+            continue
+        _WARM_BANK[key] = _WARM_BANK.pop(key)  # LRU touch
+        b_leaves, b_def = jax.tree.flatten(banked)
+        if b_def != treedef:
+            continue
+        if host is None:
+            host = [np.array(fetch(leaf)) for leaf in leaves]
+        hit = False
+        for j, bl in enumerate(b_leaves):
+            if bl.shape == host[j].shape[2:] and bl.dtype == host[j].dtype:
+                host[j][:, i] = bl
+                hit = True
+        warmed += int(hit)
+    if host is None:
+        return params, 0
+    return jax.tree.unflatten(treedef, [jnp.asarray(h) for h in host]), warmed
 
 
 #: (id(x_key), id(y_key), fingerprints, seed, n_use, input_shape) →
@@ -1093,6 +1167,19 @@ class GeneticCnnModel(GentunModel):
         params = _init_population_params(
             model, stacked, cfg["input_shape"], pop, kfold, cfg["seed"], hashes
         )
+        # Parent→child weight inheritance (multi-fidelity ladder): overlay
+        # each slot's own lower-rung trained params where shapes match, and
+        # bank fold-0 results for the NEXT rung.  Segmented single-process
+        # path only: the fused fold_parallel program has no per-fold host
+        # boundary to deposit at, and on a multi-process mesh the gather
+        # would stall every rank for a process-local cache — both fall back
+        # to cold starts, which is always correct (pure speedup).
+        warm = cfg["warm_start"] and mesh is None and not cfg["fold_parallel"]
+        if warm:
+            params, warmed = _warm_start_overlay(params, hashes[:n_real])
+            if warmed:
+                logger.debug("warm start: %d/%d slots inherited banked params",
+                             warmed, n_real)
         fold_keys = _content_keys(jax.random.PRNGKey(cfg["seed"]), kfold, hashes)
 
         if not cfg["fold_parallel"]:
@@ -1101,6 +1188,7 @@ class GeneticCnnModel(GentunModel):
                 *_device_dataset(x_train, y_train, x, y, perm, cfg, mesh),
                 val_idx, val_weight, batch_idx, mesh, batch_size, n_tr,
                 n_val_padded, eval_bs,
+                warm_keys=hashes[:n_real] if warm else None,
             )
             return accs.mean(axis=0)[:n_real]
 
@@ -1278,6 +1366,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         pop_padding=True,
         fitness_reps=1,
         entry_channel_pad=None,
+        warm_start=False,
     )
     unknown = set(config) - set(defaults)
     if unknown:
@@ -1298,6 +1387,7 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
     cfg["fitness_reps"] = 1 if cfg["fitness_reps"] is None else int(cfg["fitness_reps"])
     if cfg["fitness_reps"] < 1:
         raise ValueError("fitness_reps must be a positive int")
+    cfg["warm_start"] = bool(cfg["warm_start"])
     if cfg["entry_channel_pad"] is not None:
         cfg["entry_channel_pad"] = int(cfg["entry_channel_pad"])
         if cfg["entry_channel_pad"] < 1:

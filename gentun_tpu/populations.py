@@ -101,8 +101,21 @@ class Population:
 
     # -- construction ------------------------------------------------------
 
-    def spawn(self, genes: Optional[Mapping[str, Any]] = None) -> Individual:
-        """Create one individual of this population's species."""
+    def spawn(
+        self,
+        genes: Optional[Mapping[str, Any]] = None,
+        additional_parameters: Optional[Mapping[str, Any]] = None,
+    ) -> Individual:
+        """Create one individual of this population's species.
+
+        ``additional_parameters`` overrides the population's own config for
+        this ONE individual — the multi-fidelity engine uses it to dispatch
+        the same genes under per-rung training schedules (the cache key
+        embeds the merged config, so rungs never share fitness entries).
+        """
+        params = dict(self.additional_parameters)
+        if additional_parameters is not None:
+            params.update(additional_parameters)
         return self.species(
             x_train=self.x_train,
             y_train=self.y_train,
@@ -111,7 +124,7 @@ class Population:
             mutation_rate=self.mutation_rate,
             maximize=self.maximize,
             rng=self.rng,
-            additional_parameters=dict(self.additional_parameters),
+            additional_parameters=params,
         )
 
     def add_individual(self, individual: Individual) -> None:

@@ -3,7 +3,7 @@ resume, cross-run fitness persistence, offline-safe dataset loaders,
 tracing/metrics."""
 
 from .checkpoint import CHECKPOINT_SCHEMA, Checkpointer, load_checkpoint
-from .fitness_store import load_fitness_cache, save_fitness_cache
+from .fitness_store import fidelity_fingerprint, load_fitness_cache, save_fitness_cache
 from .profiling import EvalTimer, trace
 from .xla_cache import default_cache_dir, enable_compilation_cache
 
@@ -13,6 +13,7 @@ __all__ = [
     "CHECKPOINT_SCHEMA",
     "load_fitness_cache",
     "save_fitness_cache",
+    "fidelity_fingerprint",
     "EvalTimer",
     "trace",
     "enable_compilation_cache",

@@ -19,6 +19,15 @@ Version history:
   (``AsyncEvolution``: completion counters, dispatch-ordered in-flight
   children, ever-best individual) and the ``algorithm`` tag both loaders
   use to refuse each other's files.
+- **3**: adds the multi-fidelity ladder state (``AsyncEvolution`` with
+  ``fidelity_ladder=``): the ladder itself, per-rung completion records,
+  per-member rung/promotion markers, per-rung best genomes, and in-flight
+  entries widened from bare genes to ``{genes, rung, kind, member_index}``
+  so an in-flight PROMOTION resumes as a promotion of the same ring
+  member, not as a fresh child.  v2 files load (their in-flight lists
+  read as rung-0 children), and ladderless runs still write a state v2
+  readers would recognize field-for-field — the version is bumped because
+  a v2 reader resuming a LADDERED file would silently drop every rung.
 
 Loading is backward-compatible (a v1 file loads fine) but not
 forward-compatible: a file stamped NEWER than this code understands is
@@ -36,7 +45,7 @@ __all__ = ["Checkpointer", "load_checkpoint", "CHECKPOINT_SCHEMA"]
 
 #: Newest checkpoint layout this code can write and read (see the module
 #: docstring for the version history).
-CHECKPOINT_SCHEMA = 2
+CHECKPOINT_SCHEMA = 3
 
 
 def _to_jsonable(obj: Any) -> Any:

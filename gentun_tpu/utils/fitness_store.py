@@ -59,7 +59,8 @@ from typing import Any, Dict
 
 __all__ = [
     "load_fitness_cache", "save_fitness_cache", "tuplify",
-    "is_serializable_key", "FITNESS_PROTOCOL", "STORE_VERSION",
+    "is_serializable_key", "fidelity_fingerprint",
+    "FITNESS_PROTOCOL", "STORE_VERSION",
 ]
 
 #: Fitness-measurement RNG protocol.  Bump whenever a model's fitness for
@@ -78,8 +79,55 @@ FITNESS_PROTOCOL = 3
 #: File-schema version.  Bump together with any payload change; writers
 #: refuse files with a NEWER version (see module docstring — an older
 #: writer merging a newer file would load it as empty and clobber it).
-#: History: 1 — original payload; 2 — version guard introduced.
-STORE_VERSION = 2
+#: History: 1 — original payload; 2 — version guard introduced;
+#: 3 — entries carry a fidelity fingerprint (``[key, fitness, fp]``) so
+#: proxy-rung and full-schedule measurements of the same genome can never
+#: be conflated, even if the set of fidelity-relevant knobs changes
+#: between code revisions (mismatched fingerprints drop loudly on load).
+STORE_VERSION = 3
+
+#: The ``additional_parameters`` knobs that change what a fitness number
+#: MEANS (a 1-epoch 2-fold proxy measurement is not the full-schedule
+#: fitness of the same genome).  The fingerprint below hashes exactly
+#: this subset, so adding a knob here invalidates persisted entries that
+#: predate it — loudly, via the v3 load-time cross-check — instead of
+#: silently reusing a lower-fidelity number at a higher rung.
+FIDELITY_KNOBS = ("kfold", "epochs", "learning_rate", "fitness_reps", "warm_start")
+
+
+def fidelity_fingerprint(params: Any) -> str:
+    """12-hex-char digest of the fidelity-relevant subset of ``params``.
+
+    ``params`` may be a mapping (``additional_parameters`` as configured)
+    or its frozen form (a tuple of sorted ``(key, value)`` pairs — the
+    third component of a cache key).  Knobs absent from ``params`` are
+    omitted from the digest, so configs that never mention a knob keep a
+    stable fingerprint when defaults move.  This string is the wire
+    ``fidelity.fingerprint`` field and the store's per-entry stamp.
+    """
+    import hashlib
+
+    if not isinstance(params, dict):
+        try:
+            params = dict(params or ())
+        except (TypeError, ValueError):
+            params = {}
+    subset = {k: params[k] for k in FIDELITY_KNOBS if k in params}
+    blob = json.dumps({"v": 1, "knobs": subset}, sort_keys=True, default=str)
+    return hashlib.blake2b(blob.encode(), digest_size=6).hexdigest()
+
+
+def _key_fingerprint(key: Any) -> str:
+    """Fingerprint of a cache key's embedded ``additional_parameters``.
+
+    Every ``Individual.cache_key()`` shape ends with the frozen
+    additional_parameters tuple; anything else fingerprints as "no
+    fidelity knobs" (the empty-config digest), which is correct for
+    synthetic test keys that carry no training config at all.
+    """
+    if isinstance(key, tuple) and key and isinstance(key[-1], tuple):
+        return fidelity_fingerprint(key[-1])
+    return fidelity_fingerprint({})
 
 
 def tuplify(obj: Any) -> Any:
@@ -164,7 +212,35 @@ def _read_store(path: str):
                 path, proto, FITNESS_PROTOCOL,
             )
             return version, {}
-        return version, {tuplify(k): float(v) for k, v in payload["entries"]}
+        cache: Dict[Any, float] = {}
+        dropped = 0
+        for entry in payload["entries"]:
+            if len(entry) >= 3:
+                # v3 entry: [key, fitness, fidelity fingerprint].  The
+                # stamp was computed from the key at save time; recompute
+                # and cross-check so entries written when a DIFFERENT set
+                # of knobs counted as fidelity-relevant are dropped (a
+                # retrain) instead of reused at the wrong rung.
+                k, v, fp = entry[0], entry[1], entry[2]
+                key = tuplify(k)
+                if fp != _key_fingerprint(key):
+                    dropped += 1
+                    continue
+            else:
+                k, v = entry
+                key = tuplify(k)
+            cache[key] = float(v)
+        if dropped:
+            import logging
+
+            logging.getLogger("gentun_tpu").warning(
+                "fitness store %s: dropped %d entr%s whose fidelity "
+                "fingerprint no longer matches this code revision's "
+                "FIDELITY_KNOBS — those genomes will retrain rather than "
+                "reuse a measurement of unknown fidelity.",
+                path, dropped, "y" if dropped == 1 else "ies",
+            )
+        return version, cache
     except (ValueError, KeyError, TypeError, AttributeError) as e:
         backup = path + ".corrupt"
         try:
@@ -239,7 +315,7 @@ def save_fitness_cache(cache: Dict[Any, float], path: str) -> int:
         payload = {
             "version": STORE_VERSION,
             "protocol": FITNESS_PROTOCOL,
-            "entries": [[k, v] for k, v in merged.items()],
+            "entries": [[k, v, _key_fingerprint(k)] for k, v in merged.items()],
         }
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".fitness-", suffix=".json")
         try:

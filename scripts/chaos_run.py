@@ -425,10 +425,98 @@ def run_async_smoke() -> dict:
     }
 
 
+def run_ladder_act() -> dict:
+    """Multi-fidelity chaos act: the ASHA ladder under injected faults
+    while promotions are in flight.  A dropped ``results`` frame and an
+    evaluation failure land on a fleet running a 2-rung ladder; asserts
+    the budget completes, every fault surfaces as a ``fault_injected``
+    telemetry event, promotions actually happened and stayed within the
+    eta quota, no member is left marked promotion-pending, and the
+    broker ends quiescent (a leaked cancelled probe would show up as
+    outstanding state)."""
+    budget = 24
+    ladder = [{"kfold": 2, "epochs": (1,)}, {"kfold": 5, "epochs": (4,)}]
+    plan = FaultPlan([
+        FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=1),
+        FaultSpec(hook="client_send", kind="drop_connection", match_type="results", at=0),
+    ], seed=2026)
+    inj = FaultInjector(plan)
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_ladder_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-ladder").install()
+    port = _free_port()
+    stops = [_worker(port, injector=inj, worker_id="ladder-chaos-w0"),
+             _worker(port, worker_id="ladder-clean-w1")]
+    t0 = time.monotonic()
+    try:
+        pop = DistributedPopulation(
+            OneMax, size=POP_SIZE, seed=POP_SEED, host="127.0.0.1", port=port,
+            job_timeout=120, heartbeat_timeout=1.0)
+        try:
+            eng = AsyncEvolution(pop, tournament_size=3, seed=GA_SEED,
+                                 fidelity_ladder=ladder, eta=3, job_timeout=120)
+            eng.run(max_evaluations=budget)
+            wall = time.monotonic() - t0
+            leaked = pop.broker.outstanding()
+        finally:
+            pop.close()
+    finally:
+        for s in stops:
+            s.set()
+        tele_summary = run_tele.close()
+
+    assert eng.completed == budget, f"budget not met: {eng.completed}/{budget}"
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+    assert not any(getattr(m, "_promo_pending", False) for m in pop), \
+        "a ring member was left promotion-pending"
+    promotions = sum(1 for h in eng.history if h.get("promotion"))
+    r0, r1 = (len(v) for v in eng._rung_completions)
+    assert promotions > 0, "the ladder never promoted under chaos"
+    assert r1 <= r0 // eng.eta, f"over-promoted: rungs [{r0}, {r1}], eta {eng.eta}"
+    fired = list(inj.fired)
+    kinds_fired = sorted({f["kind"] for f in fired})
+    assert fired, "ladder fault plan never fired"
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    fault_events = [r for r in tele_lines
+                    if r.get("type") == "event" and r.get("name") == "fault_injected"]
+    assert fault_events, "ladder telemetry artifact recorded no fault events"
+    tele_event_kinds = sorted({e["data"]["kind"] for e in fault_events})
+    assert tele_event_kinds == kinds_fired, (
+        f"telemetry fault events {tele_event_kinds} != faults fired {kinds_fired}")
+
+    return {
+        "mode": "async-ladder",
+        "budget": budget,
+        "ladder": [{**r, "epochs": list(r["epochs"])} for r in ladder],
+        "eta": 3,
+        "population_size": POP_SIZE,
+        "workers": 2,
+        "fault_plan": plan.to_dict(),
+        "faults_fired": fired,
+        "fault_kinds_fired": kinds_fired,
+        "completed": eng.completed,
+        "promotions": promotions,
+        "rung_completions": [r0, r1],
+        "best_fitness": eng.best.get_fitness(),
+        "best_rung": getattr(eng.best, "_rung", None),
+        "broker_state_after_run": leaked,
+        "wall_s": round(wall, 3),
+        "telemetry": {
+            "fault_events": len(fault_events),
+            "fault_event_kinds": tele_event_kinds,
+            "n_spans": tele_summary["n_spans"],
+        },
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
     out["async_smoke"] = run_async_smoke()
+    out["ladder"] = run_ladder_act()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:
