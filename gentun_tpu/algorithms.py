@@ -356,9 +356,17 @@ class GeneticAlgorithm:
                 ind.set_fitness(ind_state["fitness"])
             individuals.append(ind)
         self.population.individuals = individuals
-        self.population.fitness_cache = {
+        restored = {
             tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
         } if proto_ok else {}
+        # A ServiceBackedCache (distributed/fitness_service.py) must keep its
+        # shared-service backing across resume; rebase() swaps contents in
+        # place instead of being replaced by a plain dict.
+        cache = self.population.fitness_cache
+        if hasattr(cache, "rebase"):
+            cache.rebase(restored)
+        else:
+            self.population.fitness_cache = restored
 
 
 class RussianRouletteGA(GeneticAlgorithm):

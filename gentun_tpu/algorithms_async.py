@@ -124,6 +124,9 @@ class _LocalEvaluator:
     def default_capacity(self) -> int:
         return self._n
 
+    def live_capacity(self) -> int:
+        return self._n  # thread pools don't resize mid-run
+
     def submit(self, individuals: List[Individual]) -> List[int]:
         tokens = []
         for ind in individuals:
@@ -203,6 +206,17 @@ class _DistributedEvaluator:
         # trajectories bit-identical.
         prefetch = getattr(self._pop, "fleet_prefetch", lambda: 0)()
         return max(1, cap) + max(0, int(prefetch))
+
+    def live_capacity(self) -> int:
+        """Instant dispatch-window read of the CURRENT fleet — no settling
+        wait.  0 means "no live workers right now" (drain, crash-reconnect
+        gap); the engine keeps its last-known target through that instant
+        rather than stalling the refill loop."""
+        cap = self._pop.fleet_capacity()
+        if cap <= 0:
+            return 0
+        prefetch = getattr(self._pop, "fleet_prefetch", lambda: 0)()
+        return cap + max(0, int(prefetch))
 
     def submit(self, individuals: List[Individual]) -> List[str]:
         ids = self._pop.submit_individuals(individuals)
@@ -322,6 +336,7 @@ class AsyncEvolution:
         self._followers: Dict[Any, List[_Work]] = {}
         self._key_to_token: Dict[Any, Any] = {}
         self._cap = 1
+        self._elastic = False
         self._evaluator = None
 
     # -- hooks (same contract as GeneticAlgorithm) -------------------------
@@ -371,6 +386,11 @@ class AsyncEvolution:
         evaluator = self._make_evaluator()
         self._evaluator = evaluator
         cap = self.max_in_flight
+        # An explicit max_in_flight pins the target; None means "track the
+        # fleet" — resolved once here (with the settling wait) and then
+        # re-read every wake-up so the in-flight target follows workers
+        # joining, draining, and re-advertising mid-run.
+        self._elastic = cap is None
         if cap is None:
             cap = evaluator.default_capacity()
         self._cap = max(1, int(cap))
@@ -425,6 +445,17 @@ class AsyncEvolution:
                             f"{self.completed}/{budget} done)")
                     for token, fitness, error in events:
                         self._on_event(token, fitness, error)
+                    if self._elastic:
+                        # Elastic fleet: follow live membership.  A 0 read
+                        # is a transient (every worker mid-reconnect or
+                        # draining) — keep the last-known target so the
+                        # refill gate doesn't collapse to zero and wedge.
+                        live = evaluator.live_capacity()
+                        if live > 0 and live != self._cap:
+                            logger.info(
+                                "in-flight target %d -> %d (fleet resized)",
+                                self._cap, live)
+                            self._cap = live
                     self._refill(evaluator, budget)
                     self._boundary()
             finally:
@@ -464,6 +495,7 @@ class AsyncEvolution:
             "completed": self.completed,
             "dispatched": self.dispatched,
             "in_flight": len(self._inflight),
+            "in_flight_target": self._cap,
             "queued": len(self._queue),
             "ring_size": self.pop_size,
             "best_fitness": best.get_fitness() if best is not None else None,
@@ -978,9 +1010,16 @@ class AsyncEvolution:
                 ind._promo_failed_rung = int(ind_state["promo_failed_rung"])
             individuals.append(ind)
         self.population.individuals = individuals
-        self.population.fitness_cache = {
+        restored = {
             tuplify(key): float(fit) for key, fit in state.get("fitness_cache", [])
         } if proto_ok else {}
+        # Keep a ServiceBackedCache's shared-service backing across resume
+        # (same duck-typed hook as GeneticAlgorithm.load_state_dict).
+        cache = self.population.fitness_cache
+        if hasattr(cache, "rebase"):
+            cache.rebase(restored)
+        else:
+            self.population.fitness_cache = restored
         best = state.get("best")
         if best is not None and proto_ok:
             b = self.population.spawn(genes=best["genes"])

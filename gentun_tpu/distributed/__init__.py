@@ -11,6 +11,7 @@ device collectives stay inside each worker (ICI, via jax).
 from .broker import GatherTimeout, JobBroker, JobFailed
 from .client import GentunClient
 from .faults import FaultInjector, FaultPlan, FaultSpec, MasterKilled
+from .fitness_service import FitnessService, FitnessServiceClient, ServiceBackedCache
 from .protocol import AuthError
 from .server import DistributedGridPopulation, DistributedPopulation
 
@@ -26,4 +27,7 @@ __all__ = [
     "FaultPlan",
     "FaultInjector",
     "MasterKilled",
+    "FitnessService",
+    "FitnessServiceClient",
+    "ServiceBackedCache",
 ]

@@ -98,7 +98,7 @@ class _Worker:
     """Per-connection state, touched only from the broker loop thread."""
 
     __slots__ = ("worker_id", "writer", "capacity", "prefetch_depth", "credit",
-                 "in_flight", "last_seen", "n_chips", "backend")
+                 "in_flight", "last_seen", "n_chips", "backend", "draining")
 
     def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
                  n_chips: int = 1, backend: Optional[str] = None,
@@ -115,6 +115,10 @@ class _Worker:
         self.last_seen = time.monotonic()
         self.n_chips = n_chips
         self.backend = backend
+        #: True once the worker announced an orderly exit (elastic
+        #: membership): no new dispatches, excluded from the fleet sums —
+        #: but still a live connection until its in-flight results land.
+        self.draining = False
 
     @property
     def window(self) -> int:
@@ -531,26 +535,37 @@ class JobBroker:
         return self.gather(list(payloads), timeout=timeout)
 
     def fleet_capacity(self) -> int:
-        """Total job slots advertised by the connected workers (0 when none).
+        """Total job slots advertised by the LIVE fleet (0 when none).
 
         The asynchronous engine's default in-flight target: capacity-C
-        fleet ⇒ keep C evaluations in flight.  Snapshot read — safe from
-        any thread.
+        fleet ⇒ keep C evaluations in flight.  Computed from current
+        membership on every call — a worker that disconnects or drains
+        leaves the sum immediately, and a late joiner enters it the moment
+        its hello is accepted, so elastic fleets resize the engine's
+        target without restarts.  Snapshot read — safe from any thread.
         """
-        return sum(w.capacity for w in list(self._workers.values()))
+        return sum(w.capacity for w in list(self._workers.values())
+                   if not w.draining)
 
     def fleet_prefetch(self) -> int:
-        """Total prefetch slots advertised by the connected workers (0 when
+        """Total prefetch slots advertised by the LIVE fleet (0 when
         none, and 0 for a fleet of pre-pipelining workers).
 
         The asynchronous engine adds this to :meth:`fleet_capacity` for its
         default in-flight target: breeding ahead to ``capacity + prefetch``
         is what keeps every worker's local ready-queue non-empty, so a
         finished window starts the next one without waiting out a
-        results→breed→dispatch round trip.  Snapshot read — safe from any
-        thread.
+        results→breed→dispatch round trip.  Draining workers are excluded
+        like disconnected ones.  Snapshot read — safe from any thread.
         """
-        return sum(w.prefetch_depth for w in list(self._workers.values()))
+        return sum(w.prefetch_depth for w in list(self._workers.values())
+                   if not w.draining)
+
+    def fleet_members(self) -> int:
+        """Number of connected workers, draining included (they still hold
+        a live connection until their in-flight results land).  Snapshot
+        read — safe from any thread."""
+        return len(self._workers)
 
     def fleet_chips(self) -> int:
         """Total accelerator chips advertised by the connected workers (≥1).
@@ -654,6 +669,8 @@ class JobBroker:
         tele = _tele.enabled()
         ops = _health.enabled()
         for w in list(self._workers.values()):
+            if w.draining:  # orderly exit in progress: never hand it work
+                continue
             batch: List[Dict[str, Any]] = []
             batch_bytes = 0
             # Keep each frame well under the protocol cap: submit() bounds
@@ -811,10 +828,15 @@ class JobBroker:
             "last_seen_age_s": round(now - w.last_seen, 3),
             "n_chips": w.n_chips,
             "backend": w.backend,
+            "draining": w.draining,
         } for w in list(self._workers.values())]
         return {
             "address": list(self._bound) if self._started.is_set() else None,
             "workers": workers,
+            "members": len(workers),
+            "draining": sum(1 for x in workers if x["draining"]),
+            "live_capacity": self.fleet_capacity(),
+            "live_prefetch": self.fleet_prefetch(),
             "queue_depth": len(self._pending),
             "open_jobs": len(self._payloads),
             "jobs_in_flight": sum(x["jobs_in_flight"] for x in workers),
@@ -871,7 +893,14 @@ class JobBroker:
                 )
             self._workers[wid] = worker
             if _tele.enabled():
-                _get_registry().gauge("broker_workers_connected").set(len(self._workers))
+                reg = _get_registry()
+                reg.gauge("broker_workers_connected").set(len(self._workers))
+                reg.gauge("fleet_members").set(len(self._workers))
+            _tele.record_event("worker_joined", {
+                "worker_id": worker.worker_id, "capacity": worker.capacity,
+                "prefetch_depth": worker.prefetch_depth,
+                "members": len(self._workers),
+            })
             writer.write(encode({"type": "welcome"}))
             logger.info(
                 "worker %s connected (capacity %d, prefetch %d, %d chip(s))",
@@ -914,8 +943,11 @@ class JobBroker:
                     # for a results→breed→dispatch round trip.  With
                     # prefetch_depth 0 (or an old worker that never sent
                     # one) this is exactly the pre-pipelining clamp.
-                    worker.credit = min(worker.window, worker.credit + add)
-                    self._dispatch()
+                    # A draining worker's late ready frame (in flight when
+                    # its drain was processed) grants nothing.
+                    if not worker.draining:
+                        worker.credit = min(worker.window, worker.credit + add)
+                        self._dispatch()
                 elif mtype == "result":
                     self._on_result(worker, msg)
                 elif mtype == "results":
@@ -934,6 +966,10 @@ class JobBroker:
                             spans = None
                 elif mtype == "fail":
                     self._on_fail(worker, msg)
+                elif mtype == "drain":
+                    self._on_drain(worker, msg)
+                elif mtype == "advertise":
+                    self._on_advertise(worker, msg)
                 else:
                     logger.warning("unknown message type %r from %s", mtype, worker.worker_id)
         except (ProtocolError, ConnectionError, asyncio.IncompleteReadError, ValueError) as e:
@@ -944,7 +980,14 @@ class JobBroker:
             if worker is not None:
                 self._workers.pop(wid, None)
                 if _tele.enabled():
-                    _get_registry().gauge("broker_workers_connected").set(len(self._workers))
+                    reg = _get_registry()
+                    reg.gauge("broker_workers_connected").set(len(self._workers))
+                    reg.gauge("fleet_members").set(len(self._workers))
+                _tele.record_event("worker_left", {
+                    "worker_id": worker.worker_id,
+                    "drained": worker.draining,
+                    "members": len(self._workers),
+                })
                 self._requeue_worker_jobs(worker, "disconnect")
                 self._dispatch()
             writer.close()
@@ -1035,3 +1078,78 @@ class JobBroker:
             if _tele.enabled():
                 self._tele_enqueued[job_id] = time.monotonic()
             self._dispatch()
+
+    def _on_drain(self, w: _Worker, msg: Dict[str, Any]) -> None:
+        """Orderly worker exit (elastic membership, protocol.py ``drain``).
+
+        The worker announces it is leaving and reports the job ids still
+        queued-but-unstarted in its local prefetch queue; those requeue
+        for redelivery NOW instead of waiting for the disconnect, while
+        the batch it is currently evaluating finishes and its results are
+        accepted normally.  From this frame on the worker gets no new
+        work, grants no credit, and leaves the fleet sums — the engines'
+        next live-capacity read shrinks accordingly.  Any dispatched job
+        the worker did NOT report (e.g. a ``jobs`` frame that was on the
+        wire when it decided to drain) is covered by the disconnect
+        requeue; at-least-once delivery makes the overlap harmless.
+        """
+        if w.draining:
+            return  # duplicate drain frame: already winding down
+        w.draining = True
+        w.credit = 0
+        tele = _tele.enabled()
+        ops = _health.enabled()
+        requeued = 0
+        for job_id in msg.get("requeue") or ():
+            job_id = str(job_id)
+            if job_id not in w.in_flight or job_id not in self._payloads:
+                continue  # finished/cancelled since the worker queued it
+            w.in_flight.discard(job_id)
+            self._pending.append(job_id)
+            if ops:
+                self._watchdog.job_removed(job_id)
+            self._tele_dispatched.pop(job_id, None)
+            if tele:
+                self._tele_enqueued[job_id] = time.monotonic()
+            requeued += 1
+        logger.info(
+            "worker %s draining: requeued %d unstarted job(s), finishing %d "
+            "in flight", w.worker_id, requeued, len(w.in_flight))
+        if tele:
+            _get_registry().counter("worker_drains_total",
+                                    worker=w.worker_id).inc()
+            self._update_flow_gauges()
+        _tele.record_event("worker_draining", {
+            "worker_id": w.worker_id, "requeued": requeued,
+            "finishing": len(w.in_flight),
+        })
+        self._dispatch()
+
+    def _on_advertise(self, w: _Worker, msg: Dict[str, Any]) -> None:
+        """Capacity/prefetch re-advertisement (elastic membership).
+
+        A worker whose local resources changed mid-run (chips freed,
+        co-tenant gone) updates its hello-time numbers in place; the
+        fleet sums — and through them the engines' in-flight targets —
+        follow on their next read.  Malformed values keep the old numbers
+        (degrade, don't drop, like every other field).  Credit above the
+        new window is clamped; already-dispatched jobs are unaffected,
+        and growth is granted by the worker's next ``ready`` frame.
+        """
+        if w.draining:
+            return  # a draining worker has no capacity to re-advertise
+        if "capacity" in msg:
+            try:
+                w.capacity = max(1, int(msg["capacity"]))
+            except (TypeError, ValueError):
+                pass
+        if "prefetch_depth" in msg:
+            w.prefetch_depth = self._parse_prefetch(msg, w.capacity)
+        w.credit = min(w.credit, w.window)
+        logger.info("worker %s re-advertised capacity=%d prefetch=%d",
+                    w.worker_id, w.capacity, w.prefetch_depth)
+        _tele.record_event("worker_readvertised", {
+            "worker_id": w.worker_id, "capacity": w.capacity,
+            "prefetch_depth": w.prefetch_depth,
+        })
+        self._dispatch()

@@ -134,6 +134,7 @@ class GentunClient:
         multihost: bool = False,
         n_chips: Optional[int] = None,
         fitness_store: Optional[str] = None,
+        cache_url: Optional[str] = None,
         fault_injector=None,
     ):
         self.species = species
@@ -180,6 +181,23 @@ class GentunClient:
         else:
             self._store_cache = None
             self._store_keys = frozenset()
+        # Networked shared fitness cache (distributed/fitness_service.py):
+        # layers read-through/write-behind service access over whatever the
+        # local store loaded, so a genome ANY run already measured is
+        # answered without training — and every new measurement is
+        # published for the rest of the fleet.  Refused for multihost
+        # workers for the same reason as fitness_store: a service hit on
+        # one host but not another would diverge the ranks' compiled
+        # programs mid-collective.
+        self._cache_client = None
+        if cache_url:
+            if multihost:
+                raise ValueError("cache_url is not supported for multihost workers")
+            from .fitness_service import FitnessServiceClient, ServiceBackedCache
+
+            self._cache_client = FitnessServiceClient(cache_url)
+            self._store_cache = ServiceBackedCache(
+                self._cache_client, self._store_cache or {})
         if self.multihost:
             from ..parallel import multihost as mh  # imports jax (opt-in only)
 
@@ -196,6 +214,11 @@ class GentunClient:
         self._handshaken = threading.Event()  # gates heartbeats until welcome
         self._jobs_done = 0
         self._last_batch_end: Optional[float] = None  # worker_idle_s anchor
+        # Elastic membership: drain() arms this; the consume loops notice
+        # at the next batch boundary, announce the drain to the broker
+        # (returning queued-but-unstarted jobs), and work() exits cleanly.
+        self._drain_req = threading.Event()
+        self._work_stop: Optional[threading.Event] = None
 
     # -- connection --------------------------------------------------------
 
@@ -361,6 +384,7 @@ class GentunClient:
         if self.multihost and not self._is_leader:
             return self._work_follower()
         stop = stop_event or threading.Event()
+        self._work_stop = stop  # shutdown() handle for signal-driven exits
         self._stop = threading.Event()
         self._jobs_done = 0  # each work() call gets a fresh budget
         # Ops-plane registration (dict writes, inert while the plane is
@@ -375,7 +399,8 @@ class GentunClient:
         hb.start()
         backoff = _ReconnectBackoff(self.reconnect_delay, self.reconnect_max_delay, self.worker_id)
         try:
-            while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
+            while (not stop.is_set() and not self._drain_req.is_set()
+                   and (max_jobs is None or self._jobs_done < max_jobs)):
                 try:
                     self._connect()
                     backoff.reset()  # a completed handshake re-arms the base delay
@@ -387,7 +412,8 @@ class GentunClient:
                     logger.error("worker %s: broker rejected credentials; giving up", self.worker_id)
                     raise
                 except (ConnectionError, OSError, ProtocolError) as e:
-                    if stop.is_set() or (max_jobs is not None and self._jobs_done >= max_jobs):
+                    if (stop.is_set() or self._drain_req.is_set()
+                            or (max_jobs is not None and self._jobs_done >= max_jobs)):
                         break
                     delay = backoff.next_delay()
                     logger.info("worker %s reconnecting in %.2gs after: %s", self.worker_id, delay, e)
@@ -396,6 +422,8 @@ class GentunClient:
         finally:
             self._stop.set()
             self._graceful_close()
+            if self._cache_client is not None:
+                self._cache_client.close()
             _health.unregister_status_provider("worker", self._ops_status)
             _health.unregister_source("worker_heartbeat")
             if self.multihost:
@@ -405,14 +433,80 @@ class GentunClient:
     def _ops_status(self) -> Dict[str, Any]:
         """The ``/statusz`` "worker" block when the ops plane runs inside
         a worker process (``--ops-port``)."""
-        return {
+        out = {
             "worker_id": self.worker_id,
             "capacity": self.capacity,
             "prefetch_depth": self.prefetch_depth,
             "jobs_done": self._jobs_done,
             "connected": self._handshaken.is_set(),
+            "draining": self._drain_req.is_set(),
             "multihost": self.multihost,
         }
+        if self._cache_client is not None:
+            out["fitness_service"] = self._cache_client.stats()
+        return out
+
+    # -- elastic membership -------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` or :meth:`shutdown` has been requested."""
+        return self._drain_req.is_set()
+
+    def drain(self) -> None:
+        """Request an orderly exit (elastic membership; thread-safe).
+
+        The consume loop notices at its next batch boundary: the window
+        currently training FINISHES and its results are delivered, any
+        batches still queued locally are returned to the broker by id
+        (redelivered to the rest of the fleet immediately), and
+        :meth:`work` returns.  A worker blocked waiting for its first jobs
+        in the serial (``prefetch_depth=0``) flow only notices when a
+        frame arrives — use :meth:`shutdown` for an immediate hard stop.
+        """
+        self._drain_req.set()
+
+    def shutdown(self) -> None:
+        """Hard stop: set work()'s stop event (the broker's disconnect
+        requeue covers everything in flight).  Thread-safe; the escalation
+        path when a drain cannot complete (no more jobs coming)."""
+        self._drain_req.set()  # don't reconnect on the way out
+        stop = self._work_stop
+        if stop is not None:
+            stop.set()
+
+    def advertise(self, capacity: Optional[int] = None,
+                  prefetch_depth: Optional[int] = None) -> None:
+        """Re-advertise capacity/prefetch to the broker (elastic membership).
+
+        Updates the local values (the next evaluation window re-chunks to
+        the new capacity) and sends the OPTIONAL ``advertise`` frame; an
+        old broker logs-and-ignores it, leaving hello-time values in
+        force.  Best-effort — a send failure surfaces on the next frame.
+        """
+        if capacity is not None:
+            self.capacity = max(1, int(capacity))
+        if prefetch_depth is not None:
+            self.prefetch_depth = max(
+                0, min(int(prefetch_depth), 4 * self.capacity))
+        try:
+            self._send({
+                "type": "advertise",
+                "capacity": self.capacity,
+                "prefetch_depth": self.prefetch_depth,
+            })
+        except OSError:
+            pass  # reconnect hello re-advertises everything anyway
+
+    def _announce_drain(self, unstarted_job_ids: List[str]) -> None:
+        """Send the ``drain`` frame; never raises (broker death during a
+        drain just means the disconnect requeue does the whole job)."""
+        try:
+            self._send({"type": "drain", "requeue": list(unstarted_job_ids)})
+        except OSError:
+            pass
+        logger.info("worker %s draining: returned %d queued job(s)",
+                    self.worker_id, len(unstarted_job_ids))
 
     def _work_follower(self) -> int:
         """Non-leader ranks: evaluate what the leader broadcasts, reply never.
@@ -454,6 +548,12 @@ class GentunClient:
         """
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
             _health.beat("worker_consume")
+            if self._drain_req.is_set():
+                # Serial flow holds nothing locally: announce with an empty
+                # requeue list (credit already granted is covered by the
+                # disconnect requeue) and exit at this batch boundary.
+                self._announce_drain([])
+                return
             self._send({"type": "ready", "credit": self.capacity})
             # The broker delivers everything our credit allows as ONE `jobs`
             # frame (credit-based prefetch), so a capacity-N worker receives
@@ -524,6 +624,22 @@ class GentunClient:
         self._send({"type": "ready", "credit": self.capacity + self.prefetch_depth})
         while not stop.is_set() and (max_jobs is None or self._jobs_done < max_jobs):
             _health.beat("worker_consume")
+            if self._drain_req.is_set():
+                # Batch boundary: the window we were evaluating has already
+                # been acked.  Hand every batch still queued locally back to
+                # the broker by id — those jobs redeliver to the rest of the
+                # fleet NOW instead of waiting out our disconnect.
+                unstarted: List[str] = []
+                while True:
+                    try:
+                        item = ready_q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if isinstance(item, list):
+                        unstarted.extend(
+                            str(j["job_id"]) for j in item if "job_id" in j)
+                self._announce_drain(unstarted)
+                return
             try:
                 item = ready_q.get(timeout=0.25)
             except _queue.Empty:

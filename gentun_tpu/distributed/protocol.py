@@ -42,6 +42,27 @@ old workers and old masters interoperate unchanged):
   ``prefetch_depth=0``) gets exactly the pre-pipelining behavior on
   both ends.
 
+Elastic-membership messages (same OPTIONAL convention — both are NEW
+worker→broker types; a broker that doesn't understand them logs-and-drops
+the frame, which degrades the worker to the inelastic flow without a
+protocol error):
+
+- ``drain`` {requeue: [job_id, ...]}: the worker announces an orderly
+  exit — it will finish what it has STARTED, hand back what it merely
+  QUEUED (the listed prefetched-but-unstarted job ids), and wants no
+  further dispatch.  The broker zeroes the worker's credit, requeues the
+  listed ids immediately, and excludes the worker from
+  ``fleet_capacity``/``fleet_prefetch`` so elastic masters shrink their
+  in-flight target right away.  The requeue list is a promptness
+  optimization only: at-least-once disconnect requeue remains the
+  correctness net, so a lost or duplicated ``drain`` frame is harmless.
+- ``advertise`` {capacity?, prefetch_depth?}: mid-run re-advertisement of
+  the ``hello`` sizing fields (a worker gained/lost chips, or an operator
+  retuned prefetch).  The broker updates the worker's window in place
+  (same clamps as ``hello``), shrinking credit immediately; growth is
+  granted by the worker's next ``ready``.  Ignored from a draining
+  worker.
+
 Multi-fidelity field (same OPTIONAL-with-conservative-default convention):
 
 - each ``jobs`` entry may carry ``fidelity`` {v, rung, fingerprint}: the

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..individuals import Individual
 from ..populations import GridPopulation, Population
+from ..telemetry import health as _health
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .broker import GatherTimeout, JobBroker, JobFailed
@@ -68,6 +69,19 @@ class DistributedPopulation(Population):
       already-measured genomes ships ZERO jobs.  The store rides
       ``clone_with``, so closing whichever generation's population the
       caller ends up holding saves every fitness the search measured.
+    - ``cache_url``: base URL of a shared fitness service
+      (``distributed/fitness_service.py``, ``http://host:port``).  The
+      population's ``fitness_cache`` becomes a
+      :class:`~gentun_tpu.distributed.fitness_service.ServiceBackedCache`:
+      local misses read through to the service (a genome ANY run already
+      measured completes instantly, never dispatched — PR-3's dispatch-side
+      dedup extended across runs) and new measurements publish
+      write-behind.  Layers OVER ``fitness_store`` (file entries seed the
+      local side; the file still saves at :meth:`close`).  Service downtime
+      degrades to local-only with a ``fitness_service_degraded`` telemetry
+      event — it never fails the search.  Note: when both ``fitness_cache``
+      and ``cache_url`` are given, the wrapped cache is a NEW dict seeded
+      from the one passed in (clones still share the wrapper by identity).
     - ``fault_injector``: chaos testing (``distributed/faults.py``).
       Passed through to an owned :class:`JobBroker`; ignored when an
       external ``broker`` is shared (inject on that broker directly).
@@ -100,6 +114,7 @@ class DistributedPopulation(Population):
         evaluate_retries: int = 0,
         failed_policy: str = "raise",
         fitness_store: Optional[str] = None,
+        cache_url: Optional[str] = None,
         speculative_fill=False,
         fault_injector=None,
         straggler_floor_s: float = 30.0,
@@ -121,6 +136,24 @@ class DistributedPopulation(Population):
                 # stored ones, hence setdefault.
                 for k, v in loaded.items():
                     fitness_cache.setdefault(k, v)
+        self.cache_url = cache_url
+        self._cache_client = None
+        self._cache_status_fn = None
+        if cache_url:
+            from .fitness_service import FitnessServiceClient, ServiceBackedCache
+
+            self._cache_client = FitnessServiceClient(cache_url)
+            # Wrap AFTER the store merge so file entries seed the local
+            # side (they stay local; only new measurements publish).  The
+            # wrapper IS the fitness_cache from here on — clones share it
+            # by identity like any cache dict.
+            fitness_cache = ServiceBackedCache(self._cache_client, fitness_cache)
+            cache = fitness_cache
+            # One callable object for register AND unregister (removal is
+            # identity-checked); closed over the cache, not self, so any
+            # clone's close() can evict it.
+            self._cache_status_fn = cache.stats
+            _health.register_status_provider("fitness_service", self._cache_status_fn)
         super().__init__(
             species,
             x_train=None,
@@ -175,6 +208,13 @@ class DistributedPopulation(Population):
                 n = save_fitness_cache(self.fitness_cache, self.fitness_store)
                 logger.info("fitness store %s: %d entries after merge", self.fitness_store, n)
         finally:
+            if self._cache_client is not None:
+                if self._cache_status_fn is not None:
+                    _health.unregister_status_provider(
+                        "fitness_service", self._cache_status_fn)
+                # Flush the write-behind queue so the LAST generation's
+                # measurements reach the service too, then stop the flusher.
+                self._cache_client.close()
             if self._owns_broker:
                 self.broker.stop()
 
@@ -580,6 +620,14 @@ class DistributedPopulation(Population):
         # Carry the store path WITHOUT reloading the file every generation:
         # the clone shares this population's cache dict already.
         clone.fitness_store = self.fitness_store
+        # Same for the shared-cache client: the ServiceBackedCache flowed in
+        # through fitness_cache= above (the ctor only wraps when cache_url is
+        # passed, which it isn't here), so hand over the client and the
+        # registered status callable — whichever population gets close()d
+        # flushes the write-behind queue and evicts the provider exactly once.
+        clone.cache_url = self.cache_url
+        clone._cache_client = self._cache_client
+        clone._cache_status_fn = self._cache_status_fn
         # An embedded broker stays closeable through evolution: every clone
         # of an owning population co-owns it, so close() on whichever
         # population the caller ends up holding (the GA hands back clones)

@@ -138,6 +138,14 @@ def main(argv=None) -> int:
                          "JSON): jobs whose genes+config were measured by a prior "
                          "run are answered without retraining.  Not available with "
                          "--coordinator (multihost) — see GentunClient.")
+    ap.add_argument("--cache-url", default=None, metavar="URL",
+                    help="shared fitness-memoization service "
+                         "(distributed/fitness_service.py), e.g. "
+                         "http://cache-host:9736: look up each job's genes+"
+                         "config before training and publish fresh fitnesses "
+                         "back (write-behind).  Layers OVER --fitness-store; "
+                         "degrades to local-only when unreachable.  Not "
+                         "available with --coordinator (multihost).")
     ap.add_argument("--fault-plan", default=None, metavar="PATH",
                     help="chaos testing: JSON FaultPlan (distributed/faults.py) "
                          "injected into this worker's client hooks")
@@ -185,6 +193,13 @@ def main(argv=None) -> int:
         raise SystemExit(f"--prefetch-depth must be >= 0, got {args.prefetch_depth}")
     if args.ops_port is not None and not 0 <= args.ops_port <= 65535:
         raise SystemExit(f"--ops-port must be in [0, 65535], got {args.ops_port}")
+    if args.cache_url is not None:
+        from .fitness_service import parse_cache_url
+
+        try:
+            args.cache_url = parse_cache_url(args.cache_url)
+        except ValueError as e:
+            raise SystemExit(f"--cache-url: {e}")
     if args.telemetry:
         from ..telemetry import spans as tele_spans
 
@@ -203,6 +218,11 @@ def main(argv=None) -> int:
         raise SystemExit("--fitness-store is not supported with --coordinator "
                          "(a store present on one host but not another would "
                          "diverge the ranks' compiled programs)")
+    if multihost and args.cache_url:
+        raise SystemExit("--cache-url is not supported with --coordinator "
+                         "(same rank-divergence hazard as --fitness-store: a "
+                         "cache hit on one host but not another would skip "
+                         "training on some ranks only)")
     if multihost:
         # Must happen before ANY jax backend init (so before evaluation);
         # after it, jax.devices() is the global pod-slice device list and
@@ -242,8 +262,34 @@ def main(argv=None) -> int:
         multihost=multihost,
         n_chips=args.n_chips,
         fitness_store=args.fitness_store,
+        cache_url=args.cache_url,
         fault_injector=injector,
     )
+    # Elastic-fleet exit protocol (DISTRIBUTED.md "Elastic fleet"): first
+    # SIGTERM/SIGINT asks for an orderly drain — finish the window being
+    # trained, hand queued-but-unstarted jobs back to the broker, exit.  A
+    # second signal stops without waiting (the broker's disconnect requeue
+    # covers whatever was in flight).  Registration fails on non-main
+    # threads (library embedding) — skip silently there, drain() is still
+    # callable programmatically.
+    import signal
+
+    def _on_signal(signum, frame):
+        if client.draining:
+            logging.getLogger("gentun_tpu.distributed").warning(
+                "second signal: stopping without waiting for in-flight work")
+            client.shutdown()
+        else:
+            logging.getLogger("gentun_tpu.distributed").info(
+                "drain requested (signal %d): finishing in-flight work, "
+                "requeueing the rest; signal again to stop now", signum)
+            client.drain()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:  # pragma: no cover - non-main-thread embedding
+        pass
     try:
         done = client.work(max_jobs=args.max_jobs)
     except AuthError as e:

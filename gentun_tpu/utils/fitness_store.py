@@ -59,7 +59,7 @@ from typing import Any, Dict
 
 __all__ = [
     "load_fitness_cache", "save_fitness_cache", "tuplify",
-    "is_serializable_key", "fidelity_fingerprint",
+    "is_serializable_key", "fidelity_fingerprint", "key_digest",
     "FITNESS_PROTOCOL", "STORE_VERSION",
 ]
 
@@ -115,6 +115,25 @@ def fidelity_fingerprint(params: Any) -> str:
     subset = {k: params[k] for k in FIDELITY_KNOBS if k in params}
     blob = json.dumps({"v": 1, "knobs": subset}, sort_keys=True, default=str)
     return hashlib.blake2b(blob.encode(), digest_size=6).hexdigest()
+
+
+def key_digest(key: Any) -> str:
+    """16-hex-char (64-bit) blake2b content address of a cache key.
+
+    The networked fitness service (``distributed/fitness_service.py``)
+    addresses entries by this digest instead of shipping whole keys: the
+    same width as the genome content hashes of FITNESS_PROTOCOL 3
+    (collision-free at 10k+ genomes), computed over the key's canonical
+    JSON serialization — so two runs that freeze the same architecture
+    and config produce the same address without sharing any state.  The
+    caller must hold a JSON-serializable key (``is_serializable_key``);
+    tuples serialize as lists, which is fine because BOTH sides of every
+    comparison go through the same ``json.dumps``.
+    """
+    import hashlib
+
+    blob = json.dumps(key, separators=(",", ":"), default=str)
+    return hashlib.blake2b(blob.encode(), digest_size=8).hexdigest()
 
 
 def _key_fingerprint(key: Any) -> str:

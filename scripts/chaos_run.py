@@ -83,10 +83,11 @@ def _free_port() -> int:
     return port
 
 
-def _worker(port, injector=None, worker_id=None):
+def _worker(port, injector=None, worker_id=None, species=None):
     stop = threading.Event()
     client = GentunClient(
-        OneMax, *DATA, host="127.0.0.1", port=port, worker_id=worker_id,
+        species or OneMax, *DATA, host="127.0.0.1", port=port,
+        worker_id=worker_id,
         heartbeat_interval=0.2, reconnect_delay=0.05, reconnect_max_delay=0.5,
         fault_injector=injector,
     )
@@ -512,11 +513,111 @@ def run_ladder_act() -> dict:
     }
 
 
+class SlowishOneMax(OneMax):
+    """OneMax with enough training delay that a mid-search service kill
+    reliably lands while generations are still running."""
+
+    def evaluate(self):
+        time.sleep(0.05)
+        return super().evaluate()
+
+
+def run_cache_chaos() -> dict:
+    """Shared-fitness-service kill act: the networked memoization cache
+    (``distributed/fitness_service.py``) dies mid-search.  Cache downtime
+    must never fail a search — the master degrades to its local fitness
+    cache, the transition surfaces as ONE ``fitness_service_degraded``
+    telemetry event, and the finished search is bit-identical to a
+    service-off run (a cache can only skip retraining, never steer)."""
+    from gentun_tpu.distributed.fitness_service import FitnessService
+
+    # Service-off reference: single-process, telemetry-free, same seeds.
+    ref = GeneticAlgorithm(
+        Population(SlowishOneMax, *DATA, size=POP_SIZE, seed=POP_SEED),
+        seed=GA_SEED)
+    ref.run(GENERATIONS)
+
+    svc = FitnessService(port=0).start()
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_cache_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-cache").install()
+    port = _free_port()
+    stops = [_worker(port, worker_id="cache-w0", species=SlowishOneMax),
+             _worker(port, worker_id="cache-w1", species=SlowishOneMax)]
+    killed_after_gen = []
+    t0 = time.monotonic()
+    try:
+        pop = DistributedPopulation(
+            SlowishOneMax, size=POP_SIZE, seed=POP_SEED, host="127.0.0.1",
+            port=port, job_timeout=120, cache_url=svc.url)
+        try:
+            ga = GeneticAlgorithm(pop, seed=GA_SEED)
+
+            def _kill_service():
+                # Pull the plug once generation 1 has landed — squarely
+                # mid-search, with generations still to run.
+                while not ga.history:
+                    time.sleep(0.005)
+                killed_after_gen.append(len(ga.history))
+                svc.stop()
+
+            killer = threading.Thread(target=_kill_service, daemon=True)
+            killer.start()
+            ga.run(GENERATIONS)
+            killer.join(timeout=10)
+            wall = time.monotonic() - t0
+            chaos_snap = _snapshot(ga)
+            leaked = pop.broker.outstanding()
+            client_stats = pop._cache_client.stats()
+        finally:
+            pop.close()
+    finally:
+        for s in stops:
+            s.set()
+        run_tele.close()
+        try:
+            svc.stop()
+        except Exception:
+            pass
+
+    ref_snap = _snapshot(ref)
+    identical = chaos_snap == ref_snap
+    assert identical, "cache-kill run diverged from the service-off run"
+    assert len(ga.history) == GENERATIONS, "search did not complete"
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+    assert client_stats["degraded_total"] >= 1, (
+        f"service kill never degraded the client: {client_stats}")
+
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    degraded_events = [r for r in tele_lines
+                       if r.get("type") == "event"
+                       and r.get("name") == "fitness_service_degraded"]
+    assert len(degraded_events) == 1, (
+        f"expected ONE degraded event per transition, got {len(degraded_events)}")
+
+    return {
+        "generations": GENERATIONS,
+        "population_size": POP_SIZE,
+        "seeds": {"population": POP_SEED, "ga": GA_SEED},
+        "workers": 2,
+        "service_killed_after_generation": killed_after_gen[0],
+        "search_completed": True,
+        "bit_identical_to_service_off_run": identical,
+        "degraded_events": len(degraded_events),
+        "client": client_stats,
+        "broker_state_after_final_gather": leaked,
+        "wall_s": round(wall, 3),
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
     out["async_smoke"] = run_async_smoke()
     out["ladder"] = run_ladder_act()
+    out["cache_service"] = run_cache_chaos()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

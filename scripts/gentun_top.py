@@ -37,6 +37,10 @@ _HEADLINE_COUNTERS = (
     "population_dedup_collapsed_total",
     "population_speculative_total",
     "faults_injected_total",
+    "fitness_service_hits_total",
+    "fitness_service_misses_total",
+    "fitness_service_evictions_total",
+    "worker_drains_total",
 )
 
 
@@ -118,8 +122,19 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
 
     fleet = statusz.get("fleet")
     if fleet:
+        # Live-membership panel (elastic fleet): how many workers are
+        # connected right now, how many are on their way out, and the
+        # dispatch window the engine's in-flight target follows.
+        members = fleet.get("members")
+        membership = ""
+        if members is not None:
+            draining = fleet.get("draining", 0)
+            membership = (f"members {members}"
+                          + (f" ({Y}{draining} draining{X})" if draining else "")
+                          + f"  window {fleet.get('live_capacity', '-')}"
+                          f"+{fleet.get('live_prefetch', '-')}  ")
         lines.append(
-            f"{B}fleet{X}  queue {fleet.get('queue_depth')}  "
+            f"{B}fleet{X}  {membership}queue {fleet.get('queue_depth')}  "
             f"open {fleet.get('open_jobs')}  in-flight {fleet.get('jobs_in_flight')}  "
             f"straggler-threshold {fleet.get('straggler_threshold_s')}s"
             + ("  requeue on" if fleet.get("straggler_requeue") else ""))
@@ -136,7 +151,8 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                     f"{w.get('jobs_in_flight', '-'):>5}"
                     f"{w.get('n_chips', '-'):>6}"
                     f"{_fmt_age(w.get('last_seen_age_s')):>8}  "
-                    f"{w.get('backend') or '-'}")
+                    f"{w.get('backend') or '-'}"
+                    + (f"  {Y}DRAINING{X}" if w.get("draining") else ""))
         for s in fleet.get("stragglers", []):
             lines.append(f"  {Y}~ straggler {s['job_id']} on {s['worker_id']} "
                          f"({s['age_s']}s > {s['threshold_s']}s){X}")
@@ -146,7 +162,22 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
         lines.append(f"{B}worker{X}  {worker.get('worker_id')}  "
                      f"cap {worker.get('capacity')}  "
                      f"done {worker.get('jobs_done')}  "
-                     f"{'connected' if worker.get('connected') else 'DISCONNECTED'}")
+                     f"{'connected' if worker.get('connected') else 'DISCONNECTED'}"
+                     + (f"  {Y}DRAINING{X}" if worker.get("draining") else ""))
+
+    # Shared fitness-cache panel: the "fitness_service" status provider is
+    # registered by whichever side runs a FitnessServiceClient (master via
+    # cache_url=, worker via --cache-url → client _ops_status block).
+    cache = statusz.get("fitness_service") or (worker or {}).get("fitness_service")
+    if cache:
+        rate = cache.get("hit_rate")
+        state = (f"{R}DEGRADED (local-only){X}" if cache.get("degraded")
+                 else f"{G}connected{X}")
+        lines.append(f"{B}fitness cache{X}  {cache.get('url')}  {state}  "
+                     f"hits {cache.get('hits')}  misses {cache.get('misses')}  "
+                     f"hit-rate {'-' if rate is None else f'{rate:.1%}'}  "
+                     f"pending-publish {cache.get('pending_publish')}  "
+                     f"local {cache.get('local_entries', '-')}")
 
     totals = _parse_counters(metrics_text or "")
     headline = [(n, totals[n]) for n in _HEADLINE_COUNTERS if n in totals]

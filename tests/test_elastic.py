@@ -1,0 +1,273 @@
+"""Elastic fleet membership: drain, late-join, live capacity tracking.
+
+Workers may join and leave a search mid-run (ASHA's elastic worker pool —
+Li et al. 2020).  These tests cover the drain protocol (finish in-flight,
+requeue queued-but-unstarted, stop dispatching), capacity
+re-advertisement, the engine-side fix for stale fleet sizing (the
+in-flight target must follow the LIVE fleet, not the connect-time
+snapshot), and the end-to-end drain + late-join scenario: best fitness
+equals a fixed-fleet run, no job lost, and the ``fleet_members`` gauge
+tracks the membership timeline.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import AsyncEvolution, GeneticAlgorithm, Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import DistributedPopulation, GentunClient
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+
+
+class OneMax(Individual):
+    """Pure function of genes: local and distributed evaluation agree
+    bit-for-bit, so elastic and fixed-fleet searches are comparable."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+class SlowOneMax(OneMax):
+    """Slow enough that membership changes land mid-run, not between runs."""
+
+    def evaluate(self):
+        time.sleep(0.15)
+        return super().evaluate()
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+def _spawn_worker(species, port, worker_id, capacity=1, prefetch_depth=None):
+    """A worker we keep a handle on (drain() needs the client object)."""
+    stop = threading.Event()
+    client = GentunClient(
+        species, *DATA, host="127.0.0.1", port=port, capacity=capacity,
+        prefetch_depth=prefetch_depth, worker_id=worker_id,
+        heartbeat_interval=0.2, reconnect_delay=0.05,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return client, stop, t
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestBrokerMembership:
+    def test_drain_excludes_worker_from_live_sums(self):
+        pop = DistributedPopulation(OneMax, size=2, seed=0, port=0, maximize=True)
+        try:
+            _, port = pop.broker_address
+            c0, s0, _ = _spawn_worker(OneMax, port, "m-w0")
+            c1, s1, _ = _spawn_worker(OneMax, port, "m-w1")
+            assert _wait(lambda: pop.broker.fleet_members() == 2)
+            cap_full = pop.fleet_capacity()
+            assert cap_full == 2
+            c1.drain()
+            # An idle draining worker leaves entirely (work() returns);
+            # on the way out it must stop counting toward the live fleet.
+            assert _wait(lambda: pop.fleet_capacity() == 1)
+            assert _wait(lambda: pop.broker.fleet_members() == 1)
+            s0.set(), s1.set()
+        finally:
+            pop.close()
+
+    def test_advertise_resizes_dispatch_window(self):
+        pop = DistributedPopulation(OneMax, size=2, seed=0, port=0, maximize=True)
+        try:
+            _, port = pop.broker_address
+            c0, s0, _ = _spawn_worker(OneMax, port, "a-w0", capacity=1,
+                                      prefetch_depth=0)
+            assert _wait(lambda: pop.fleet_capacity() == 1)
+            assert pop.fleet_prefetch() == 0
+            c0.advertise(capacity=3, prefetch_depth=2)
+            assert _wait(lambda: pop.fleet_capacity() == 3)
+            assert pop.fleet_prefetch() == 2
+            # Shrink works too (credit is clamped broker-side).
+            c0.advertise(capacity=1, prefetch_depth=0)
+            assert _wait(lambda: pop.fleet_capacity() == 1)
+            assert pop.fleet_prefetch() == 0
+            s0.set()
+        finally:
+            pop.close()
+
+    def test_late_join_after_start_gets_credit(self):
+        # A worker connecting AFTER jobs were queued still gets dispatched
+        # to immediately (hello accepted mid-run, credits granted).
+        pop = DistributedPopulation(OneMax, size=4, seed=1, port=0,
+                                    maximize=True, job_timeout=30)
+        try:
+            _, port = pop.broker_address
+            done = []
+
+            def master():
+                pop.evaluate()
+                done.append(True)
+
+            t = threading.Thread(target=master, daemon=True)
+            t.start()
+            time.sleep(0.3)  # jobs are queued, no worker yet
+            c0, s0, _ = _spawn_worker(OneMax, port, "l-w0")
+            t.join(timeout=30)
+            assert done and all(i.fitness_evaluated for i in pop)
+            s0.set()
+        finally:
+            pop.close()
+
+
+class TestStaleFleetSizing:
+    def test_async_in_flight_target_follows_disconnect(self):
+        """Regression: the engine resolved its in-flight target ONCE at
+        run() start; a worker lost mid-run left it dispatching into a
+        window the fleet no longer had.  The target must drop."""
+        pop = DistributedPopulation(SlowOneMax, size=4, seed=7, port=0,
+                                    job_timeout=60, maximize=True)
+        c0 = c1 = None
+        try:
+            _, port = pop.broker_address
+            c0, s0, _ = _spawn_worker(SlowOneMax, port, "s-w0")
+            c1, s1, _ = _spawn_worker(SlowOneMax, port, "s-w1")
+            assert _wait(lambda: pop.broker.fleet_members() == 2)
+            eng = AsyncEvolution(pop, tournament_size=3, seed=5, job_timeout=60)
+            caps = []
+
+            def _chaos():
+                # Half the fleet vanishes (hard stop, not drain) once the
+                # search is underway.
+                _wait(lambda: eng.completed >= 3, timeout=30)
+                s1.set()
+                while eng._evaluator is not None:
+                    caps.append(eng._cap)
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=_chaos, daemon=True)
+            t.start()
+            eng.run(max_evaluations=20)
+            t.join(timeout=10)
+            # Initial target: 2 workers × (capacity 1 + default prefetch 1).
+            # After the disconnect the live window is one worker's 2.
+            assert eng._cap == 2, f"target never followed the fleet: {eng._cap}"
+            assert eng.completed == 20
+            s0.set()
+        finally:
+            pop.close()
+
+    def test_explicit_max_in_flight_is_pinned(self):
+        # An explicit target must NOT follow the fleet — the operator said 1.
+        pop = DistributedPopulation(OneMax, size=4, seed=2, port=0,
+                                    job_timeout=60, maximize=True)
+        try:
+            _, port = pop.broker_address
+            c0, s0, _ = _spawn_worker(OneMax, port, "p-w0", capacity=2)
+            eng = AsyncEvolution(pop, tournament_size=3, max_in_flight=1,
+                                 seed=5, job_timeout=60)
+            eng.run(max_evaluations=6)
+            assert eng._cap == 1
+            assert not eng._elastic
+            s0.set()
+        finally:
+            pop.close()
+
+
+@pytest.mark.slow
+class TestElasticEndToEnd:
+    def test_drain_plus_late_join_matches_fixed_fleet(self):
+        """The acceptance scenario: one worker drains mid-generation and a
+        replacement late-joins.  The search must lose no job, finish with
+        the fixed-fleet best (generational trajectories are seeded and
+        fitness is a pure function, so elastic timing cannot steer them),
+        and the ``fleet_members`` gauge must trace 2 → 1 → 2."""
+        generations, size = 3, 6
+        # Reference: same seeds, local evaluation (bit-identical by the
+        # distributed-parity contract).
+        ref_pop = Population(OneMax, DATA, size=size, seed=11, maximize=True)
+        ref_best = GeneticAlgorithm(ref_pop, seed=5).run(generations)
+
+        spans_mod.enable()
+        reg = get_registry()
+        pop = DistributedPopulation(SlowOneMax, size=size, seed=11, port=0,
+                                    job_timeout=60, maximize=True)
+        members_seen, sampling = [], threading.Event()
+        try:
+            _, port = pop.broker_address
+            c0, s0, _ = _spawn_worker(SlowOneMax, port, "e-w0")
+            c1, s1, _ = _spawn_worker(SlowOneMax, port, "e-w1")
+            assert _wait(lambda: pop.broker.fleet_members() == 2)
+            gauge = reg.gauge("fleet_members")
+
+            def _sample():
+                while not sampling.is_set():
+                    members_seen.append(gauge.value)
+                    time.sleep(0.005)
+
+            sampler = threading.Thread(target=_sample, daemon=True)
+            sampler.start()
+
+            joined = []
+
+            def _churn():
+                # Drain one worker mid-generation-1, late-join a fresh one
+                # a beat later.
+                time.sleep(0.4)
+                c1.drain()
+                _wait(lambda: pop.broker.fleet_members() == 1, timeout=30)
+                time.sleep(0.2)
+                joined.append(_spawn_worker(SlowOneMax, port, "e-w2"))
+
+            churn = threading.Thread(target=_churn, daemon=True)
+            churn.start()
+            ga = GeneticAlgorithm(pop, seed=5)
+            best = ga.run(generations)
+            churn.join(timeout=30)
+
+            assert best.get_fitness() == ref_best.get_fitness()
+            assert best.get_genes() == ref_best.get_genes()
+            # No job lost: the broker's books are balanced.
+            out = pop.broker.outstanding()
+            assert all(v == 0 for v in out.values()), out
+            # Membership timeline: 2 workers, down to 1, back to 2.
+            sampling.set()
+            sampler.join(timeout=5)
+            squashed = [m for i, m in enumerate(members_seen)
+                        if i == 0 or m != members_seen[i - 1]]
+            assert _subsequence([2, 1, 2], squashed), squashed
+            # The drain was counted (worker-labeled counter).
+            snap = reg.snapshot()
+            drains = sum(c["value"] for c in snap["counters"]
+                         if c["name"] == "worker_drains_total")
+            assert drains >= 1
+            s0.set(), s1.set()
+            for c, s, _t in joined:
+                s.set()
+        finally:
+            sampling.set()
+            pop.close()
+
+
+def _subsequence(needle, haystack):
+    it = iter(haystack)
+    return all(any(x == want for x in it) for want in needle)

@@ -1,0 +1,370 @@
+"""Networked shared fitness-memoization service (distributed/fitness_service.py).
+
+The file store already carries measurements across runs; the service
+promotes it to a network cache shared by concurrent searches and elastic
+fleets.  These tests cover the wire contract (content addressing, version
+skew → 409, LRU), the degradation boundary (a dead service must cost
+misses, never exceptions), the ServiceBackedCache layering semantics, and
+the file store's concurrent-writer safety the service builds on.
+"""
+
+import json
+import multiprocessing
+import os
+import time
+
+import pytest
+
+from gentun_tpu.distributed.fitness_service import (
+    FitnessService,
+    FitnessServiceClient,
+    ServiceBackedCache,
+    parse_cache_url,
+    wire_key,
+)
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+from gentun_tpu.utils.fitness_store import (
+    FITNESS_PROTOCOL,
+    STORE_VERSION,
+    key_digest,
+    load_fitness_cache,
+    save_fitness_cache,
+)
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def record(self, rec):
+        self.records.append(rec)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+@pytest.fixture
+def service():
+    svc = FitnessService(port=0, max_entries=100)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+KEY = (("genes", (1, 0, 1)), (("epochs", 2), ("kfold", 3)))
+KEY2 = (("genes", (0, 1, 0)), (("epochs", 2), ("kfold", 3)))
+
+
+class TestWireKey:
+    def test_digest_is_64_bit_hex(self):
+        d = key_digest(KEY)
+        assert len(d) == 16
+        int(d, 16)  # hex
+
+    def test_wire_key_carries_fidelity_fingerprint(self):
+        # Same genes, different fidelity → different service addresses:
+        # a proxy measurement can never answer a full-schedule lookup.
+        proxy = (("genes", (1, 0, 1)), (("epochs", 1), ("kfold", 2)))
+        full = (("genes", (1, 0, 1)), (("epochs", 20), ("kfold", 5)))
+        assert wire_key(proxy) != wire_key(full)
+        assert ":" in wire_key(proxy)
+
+    def test_unserializable_key_is_none(self):
+        assert wire_key((("blob", b"\x00"),)) is None
+
+    def test_stable_across_processes(self):
+        # The address is a pure function of the key — no per-process salt.
+        assert wire_key(KEY) == wire_key(tuple(KEY))
+
+
+class TestParseCacheUrl:
+    def test_good_urls_normalize(self):
+        assert parse_cache_url("http://10.0.0.2:9736/") == "http://10.0.0.2:9736"
+
+    @pytest.mark.parametrize("bad", [
+        "10.0.0.2:9736",          # no scheme
+        "ftp://host:21",           # wrong scheme
+        "http://host",             # no port
+        "http://:9736",            # no host
+        "http://host:9736/path",   # path
+        "http://host:9736?x=1",    # query
+    ])
+    def test_bad_urls_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_cache_url(bad)
+
+
+class TestServiceWire:
+    def test_lookup_and_publish_roundtrip(self, service):
+        c = FitnessServiceClient(service.url)
+        wk = wire_key(KEY)
+        assert c.lookup([wk]) == {}
+        c.publish([(wk, 0.5)])
+        assert c.flush(5.0)
+        assert c.lookup([wk]) == {wk: 0.5}
+        c.close()
+
+    def test_cross_client_sharing(self, service):
+        # The point of the service: run B sees what run A measured.
+        a, b = FitnessServiceClient(service.url), FitnessServiceClient(service.url)
+        a.publish([(wire_key(KEY), 0.9)])
+        assert a.flush(5.0)
+        assert b.lookup([wire_key(KEY)]) == {wire_key(KEY): 0.9}
+        a.close(), b.close()
+
+    def test_lru_eviction_bounded(self):
+        svc = FitnessService(port=0, max_entries=3)
+        svc.start()
+        try:
+            c = FitnessServiceClient(svc.url)
+            for i in range(5):
+                c.publish([(f"{i:016x}:", float(i))])
+                assert c.flush(5.0)
+            st = svc.stats()
+            assert st["entries"] == 3
+            assert st["evictions"] == 2
+            # Coldest entries went first.
+            assert c.lookup(["0" * 16 + ":"]) == {}
+            assert c.lookup([f"{4:016x}:"]) != {}
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_lookup_refreshes_lru_position(self):
+        svc = FitnessService(port=0, max_entries=2)
+        svc.start()
+        try:
+            c = FitnessServiceClient(svc.url)
+            c.publish([("a" * 16 + ":", 1.0), ("b" * 16 + ":", 2.0)])
+            assert c.flush(5.0)
+            # Touch "a", then insert a third: "b" (now coldest) evicts.
+            assert c.lookup(["a" * 16 + ":"])
+            c.publish([("c" * 16 + ":", 3.0)])
+            assert c.flush(5.0)
+            assert c.lookup(["a" * 16 + ":"]) != {}
+            assert c.lookup(["b" * 16 + ":"]) == {}
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_version_skew_is_409_and_degrades(self, service):
+        # A mismatched client must be refused (all-writers-upgrade-together,
+        # enforced at the wire) and must degrade, not crash.
+        import urllib.request
+
+        body = json.dumps({"v": 1, "version": STORE_VERSION + 1,
+                           "protocol": FITNESS_PROTOCOL,
+                           "keys": ["00" * 8 + ":"]}).encode()
+        req = urllib.request.Request(
+            service.url + "/v1/lookup", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 409
+        refusal = json.loads(ei.value.read().decode())
+        assert refusal["version"] == STORE_VERSION
+        assert refusal["client_version"] == STORE_VERSION + 1
+
+    def test_statusz_serves_counters(self, service):
+        import urllib.request
+
+        c = FitnessServiceClient(service.url)
+        c.publish([(wire_key(KEY), 0.25)])
+        assert c.flush(5.0)
+        c.lookup([wire_key(KEY), wire_key(KEY2)])
+        with urllib.request.urlopen(service.url + "/statusz", timeout=5) as r:
+            st = json.loads(r.read().decode())
+        assert st["puts"] == 1 and st["hits"] == 1 and st["misses"] == 1
+        c.close()
+
+
+class TestDegradation:
+    def test_dead_service_costs_misses_never_exceptions(self):
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        c = FitnessServiceClient("http://127.0.0.1:1", timeout=0.2, cooldown=30.0)
+        assert c.lookup([wire_key(KEY)]) == {}
+        c.publish([(wire_key(KEY), 0.5)])  # must not raise
+        assert not c.flush(1.0)  # can't drain to a dead service
+        assert c.degraded
+        # ONE degraded event per transition, with the url.
+        evs = [r for r in sink.records
+               if r.get("type") == "event" and r["name"] == "fitness_service_degraded"]
+        assert len(evs) == 1
+        assert evs[0]["data"]["url"] == "http://127.0.0.1:1"
+        assert get_registry().counter("fitness_service_degraded_total").value == 1
+        c.close(flush_timeout=0.1)
+
+    def test_cooldown_prevents_per_genome_timeouts(self):
+        c = FitnessServiceClient("http://127.0.0.1:1", timeout=0.2, cooldown=60.0)
+        c.lookup(["a" * 16 + ":"])  # pays the one connect failure
+        t0 = time.monotonic()
+        for _ in range(50):
+            c.lookup(["b" * 16 + ":"])  # inside the cooldown: no socket touch
+        assert time.monotonic() - t0 < 0.5
+        c.close(flush_timeout=0.1)
+
+    def test_recovery_after_cooldown(self):
+        svc = FitnessService(port=0)
+        svc.start()
+        try:
+            url = svc.url
+            c = FitnessServiceClient(url, timeout=1.0, cooldown=0.1)
+            svc.stop()
+            assert c.lookup([wire_key(KEY)]) == {}
+            assert c.degraded
+            # Restart on the same port; after the cooldown the client heals.
+            host, port = svc.address
+            svc2 = FitnessService(host=host, port=port)
+            svc2.start()
+            try:
+                svc2.publish([[wire_key(KEY), 0.75]])
+                time.sleep(0.15)
+                assert c.lookup([wire_key(KEY)]) == {wire_key(KEY): 0.75}
+                assert not c.degraded
+            finally:
+                svc2.stop()
+            c.close(flush_timeout=0.1)
+        finally:
+            try:
+                svc.stop()
+            except Exception:
+                pass
+
+
+class TestServiceBackedCache:
+    def test_read_through_adopts_hit_locally(self, service):
+        publisher = FitnessServiceClient(service.url)
+        publisher.publish([(wire_key(KEY), 0.6)])
+        assert publisher.flush(5.0)
+        cache = ServiceBackedCache(FitnessServiceClient(service.url))
+        assert KEY in cache
+        assert cache[KEY] == 0.6
+        # Adopted: the second touch is a plain dict read (no RTT) — the
+        # service-side hit counter must not move again.
+        before = service.stats()["hits"]
+        assert cache.get(KEY) == 0.6
+        assert service.stats()["hits"] == before
+        publisher.close(), cache.client.close()
+
+    def test_write_publishes_for_the_next_run(self, service):
+        cache = ServiceBackedCache(FitnessServiceClient(service.url))
+        cache[KEY] = 0.8
+        assert cache.client.flush(5.0)
+        other = ServiceBackedCache(FitnessServiceClient(service.url))
+        assert other.get(KEY) == 0.8
+        cache.client.close(), other.client.close()
+
+    def test_local_miss_and_service_miss_is_keyerror(self, service):
+        cache = ServiceBackedCache(FitnessServiceClient(service.url))
+        assert KEY2 not in cache
+        assert cache.get(KEY2, -1.0) == -1.0
+        with pytest.raises(KeyError):
+            cache[KEY2]
+        cache.client.close()
+
+    def test_rebase_keeps_service_backing(self, service):
+        # Checkpoint resume replaces the cache contents; the service layer
+        # must survive (the load_state_dict paths call rebase()).
+        publisher = FitnessServiceClient(service.url)
+        publisher.publish([(wire_key(KEY), 0.4)])
+        assert publisher.flush(5.0)
+        cache = ServiceBackedCache(FitnessServiceClient(service.url))
+        cache.rebase({KEY2: 1.5})
+        assert dict.__len__(cache) == 1  # local contents replaced
+        assert cache.get(KEY) == 0.4  # but the service still answers
+        publisher.close(), cache.client.close()
+
+    def test_seed_dict_wins_over_service(self, service):
+        publisher = FitnessServiceClient(service.url)
+        publisher.publish([(wire_key(KEY), 99.0)])
+        assert publisher.flush(5.0)
+        cache = ServiceBackedCache(FitnessServiceClient(service.url), {KEY: 0.1})
+        assert cache[KEY] == 0.1  # local-first
+        publisher.close(), cache.client.close()
+
+    def test_unserializable_keys_stay_local_only(self, service):
+        cache = ServiceBackedCache(FitnessServiceClient(service.url))
+        k = (("blob", b"\x00"),)
+        cache[k] = 2.0
+        assert cache[k] == 2.0
+        assert cache.client.flush(2.0)
+        assert service.stats()["entries"] == 0  # never reached the wire
+        cache.client.close()
+
+    def test_degraded_cache_behaves_like_plain_dict(self):
+        cache = ServiceBackedCache(
+            FitnessServiceClient("http://127.0.0.1:1", timeout=0.2, cooldown=60.0))
+        cache[KEY] = 0.3
+        assert cache[KEY] == 0.3
+        assert KEY2 not in cache
+        cache.client.close(flush_timeout=0.1)
+
+
+def _writer_proc(path, start, stop, lo):
+    """Append 200 distinct v3 triples, racing the sibling process."""
+    # Config-free keys all stamp the same empty-config fingerprint,
+    # keeping the test focused on file-level atomicity.
+    start.wait(10)
+    for i in range(lo, lo + 200):
+        save_fitness_cache({(("g", i),): float(i)}, path)
+    stop.set()
+
+
+class TestConcurrentStoreWriters:
+    def test_two_processes_append_without_corruption(self, tmp_path):
+        # The service's durability story still rests on the file store's
+        # read-merge-write-under-flock cycle: two processes hammering the
+        # same store must union cleanly — no lost entries, no quarantine.
+        path = str(tmp_path / "store.json")
+        ctx = multiprocessing.get_context("spawn")
+        start = ctx.Event()
+        stops = [ctx.Event(), ctx.Event()]
+        procs = [
+            ctx.Process(target=_writer_proc, args=(path, start, stops[0], 0)),
+            ctx.Process(target=_writer_proc, args=(path, start, stops[1], 1000)),
+        ]
+        for p in procs:
+            p.start()
+        start.set()
+        for p in procs:
+            p.join(timeout=120)
+            assert p.exitcode == 0
+        assert not os.path.exists(path + ".corrupt")
+        cache = load_fitness_cache(path)
+        assert len(cache) == 400  # both writers' entries all survived
+        assert cache[(("g", 5),)] == 5.0
+        assert cache[(("g", 1005),)] == 1005.0
+        with open(path) as fh:
+            raw = json.load(fh)
+        assert raw["version"] == STORE_VERSION
+        # v3 triples: [key, fitness, fingerprint].
+        assert all(len(t) == 3 for t in raw["entries"])
+
+    def test_fingerprint_mismatch_still_dropped_after_merge(self, tmp_path):
+        # The recompute path must survive concurrent merging: a tampered
+        # fingerprint is dropped on load (forcing a retrain), not trusted.
+        path = str(tmp_path / "store.json")
+        key = (("g", 1), (("epochs", 2),))
+        save_fitness_cache({key: 1.0}, path)
+        save_fitness_cache({(("g", 2),): 2.0}, path)  # a merge cycle on top
+        with open(path) as fh:
+            raw = json.load(fh)
+        for triple in raw["entries"]:
+            if triple[0] == [["g", 1], [["epochs", 2]]]:
+                triple[2] = "0" * 12  # tamper that key's fingerprint
+        with open(path, "w") as fh:
+            json.dump(raw, fh)
+        cache = load_fitness_cache(path)
+        assert key not in cache  # mismatch → recompute
+        assert (("g", 2),) in cache  # untampered survives
