@@ -206,7 +206,11 @@ class GeneticAlgorithm:
         )
         # One root span per run → one trace_id stitching every generation
         # (and, via payload propagation, every worker span) together.
-        _health.register_status_provider("engine", self._ops_status)
+        # Engine status is keyed by search session (multi-tenant brokers:
+        # N engines sharing a fleet each get a /statusz row instead of
+        # last-writer-wins); single-tenant runs key under "default".
+        self._status_session = getattr(self.population, "session", None) or "default"
+        _health.register_engine_status(self._status_session, self._ops_status)
         try:
             with _tele.span("run", {"generations": max(remaining, 0)}) as run_span:
                 # /statusz "active trace_id": the no-op span has no
@@ -220,7 +224,7 @@ class GeneticAlgorithm:
                     self.population.evaluate()
                     best = self.population.get_fittest()
         finally:
-            _health.unregister_status_provider("engine", self._ops_status)
+            _health.unregister_engine_status(self._status_session, self._ops_status)
         logger.info("search done: best fitness %.6g, genes %s", best.get_fitness(), best.get_genes())
         return best
 
@@ -236,6 +240,7 @@ class GeneticAlgorithm:
             best = max(fits) if self.population.maximize else min(fits)
         return {
             "mode": "generational",
+            "session": getattr(self, "_status_session", "default"),
             "generation": self.generation,
             "population_size": len(self.population),
             "best_fitness": best,

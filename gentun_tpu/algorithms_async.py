@@ -424,7 +424,8 @@ class AsyncEvolution:
             "starting AsyncEvolution: ring=%d, budget=%d (%d done), in-flight target=%d",
             self.pop_size, budget, self.completed, self._cap,
         )
-        _health.register_status_provider("engine", self._ops_status)
+        self._status_session = getattr(self.population, "session", None) or "default"
+        _health.register_engine_status(self._status_session, self._ops_status)
         with _tele.span("run", {"mode": "async", "budget": budget,
                                 "max_in_flight": self._cap}) as run_span:
             # /statusz "active trace_id" (None while telemetry is off —
@@ -459,7 +460,7 @@ class AsyncEvolution:
                     self._refill(evaluator, budget)
                     self._boundary()
             finally:
-                _health.unregister_status_provider("engine", self._ops_status)
+                _health.unregister_engine_status(self._status_session, self._ops_status)
                 leftover = list(self._inflight)
                 if leftover:
                     # Budget reached with children still training: their
@@ -492,6 +493,7 @@ class AsyncEvolution:
         best = self.best
         status = {
             "mode": "async",
+            "session": getattr(self, "_status_session", "default"),
             "completed": self.completed,
             "dispatched": self.dispatched,
             "in_flight": len(self._inflight),

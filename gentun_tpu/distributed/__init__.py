@@ -14,6 +14,14 @@ from .faults import FaultInjector, FaultPlan, FaultSpec, MasterKilled
 from .fitness_service import FitnessService, FitnessServiceClient, ServiceBackedCache
 from .protocol import AuthError
 from .server import DistributedGridPopulation, DistributedPopulation
+from .sessions import (
+    DEFAULT_SESSION,
+    FairShareScheduler,
+    SearchSession,
+    SessionClient,
+    UnknownSessionError,
+    genome_key,
+)
 
 __all__ = [
     "JobBroker",
@@ -30,4 +38,10 @@ __all__ = [
     "FitnessService",
     "FitnessServiceClient",
     "ServiceBackedCache",
+    "DEFAULT_SESSION",
+    "SearchSession",
+    "SessionClient",
+    "FairShareScheduler",
+    "UnknownSessionError",
+    "genome_key",
 ]

@@ -43,13 +43,20 @@ import logging
 import threading
 import time
 import uuid
-from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
 from ..telemetry import health as _health
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
+from .sessions import (
+    DEFAULT_SESSION,
+    FairShareScheduler,
+    SearchSession,
+    SessionRegistry,
+    UnknownSessionError,
+    genome_key,
+)
 
 __all__ = ["JobBroker", "JobFailed", "GatherTimeout"]
 
@@ -161,6 +168,17 @@ class JobBroker:
         for redelivery (the membership dedup drops the stalled worker's
         late result, exactly like disconnect redelivery).  Off by default —
         flagging alone never changes the dispatch schedule.
+    quarantine_after:
+        Poison-genome isolation (sessions.py): terminal failures of the
+        SAME genome within one session before that session refuses to
+        dispatch it again.  Per-session by design — a genome that crashes
+        tenant A's species may be fine for tenant B's.
+    quarantine_crash_requeues:
+        Opt-in crash isolation: after this many disconnect-redeliveries of
+        one job, the job fails terminally and its genome is quarantined in
+        its session, instead of crash-looping through the whole fleet.
+        ``None`` (default) preserves unbounded AMQP-style disconnect
+        redelivery — required by the chaos suite's kill/redeliver tests.
     """
 
     def __init__(
@@ -174,6 +192,8 @@ class JobBroker:
         straggler_floor_s: float = 30.0,
         straggler_k: float = 4.0,
         straggler_requeue: bool = False,
+        quarantine_after: int = 3,
+        quarantine_crash_requeues: Optional[int] = None,
     ):
         self._host = host
         self._port = port
@@ -204,10 +224,22 @@ class JobBroker:
         # Loop-thread state.  A job is "open" iff its id is in _payloads:
         # the first result pops the payload, and every other path (dispatch,
         # requeue, fail) checks membership — that is what makes redelivery
-        # duplicates and stale pending entries harmless.
-        self._pending: deque[str] = deque()
+        # duplicates and stale scheduler entries harmless.
+        #
+        # Multi-tenant sessions (sessions.py): the single pending deque is
+        # replaced by a fair-share scheduler over per-session queues.  With
+        # one session (the implicit default) it degenerates to the old FIFO.
+        self._registry = SessionRegistry(quarantine_after=quarantine_after)
+        self._quarantine_crash_requeues = (
+            None if quarantine_crash_requeues is None
+            else max(1, int(quarantine_crash_requeues)))
+        self._sched = FairShareScheduler(self._registry.weight)
         self._payloads: Dict[str, Dict[str, Any]] = {}
         self._fail_counts: Dict[str, int] = {}
+        # Session tenancy maps, popped exactly where _payloads is popped.
+        self._job_session: Dict[str, str] = {}
+        self._job_genome: Dict[str, str] = {}
+        self._crash_counts: Dict[str, int] = {}
         self._workers: Dict[int, _Worker] = {}
         self._worker_seq = itertools.count()
         # Telemetry (loop-thread only): monotonic (re)enqueue stamp per open
@@ -326,10 +358,30 @@ class JobBroker:
 
     # -- master-side API (called from any thread) --------------------------
 
-    def submit(self, payloads: Dict[str, Dict[str, Any]]) -> None:
-        """Enqueue jobs: {job_id: payload}.  Non-blocking."""
+    def submit(self, payloads: Dict[str, Dict[str, Any]],
+               session: Optional[str] = None) -> None:
+        """Enqueue jobs: {job_id: payload}.  Non-blocking.
+
+        ``session`` tags the jobs with a tenant opened via
+        :meth:`open_session`; ``None`` rides the implicit default session
+        (the pre-session single-tenant behavior, byte-identical on the
+        wire).  Naming an unknown or closed session raises
+        :class:`~.sessions.UnknownSessionError` HERE, in the caller's
+        thread — loud, never a silent drop — and bumps
+        ``session_rejected_total{session}``.
+        """
         if not self._started.is_set():
             raise RuntimeError("broker not started")
+        sid = str(session) if session else DEFAULT_SESSION
+        if session is not None:
+            sess = self._registry.peek(sid)
+            if sess is None or sess.closed:
+                if sess is not None:
+                    sess.rejected += len(payloads)
+                _get_registry().counter("session_rejected_total", session=sid).inc(len(payloads))
+                raise UnknownSessionError(
+                    f"session {sid!r} is {'closed' if sess is not None else 'unknown'}; "
+                    f"open_session() it before submitting")
 
         # Validate frame size in the CALLER's thread so an oversized payload
         # raises where the submitter can see it, instead of being swallowed
@@ -338,19 +390,73 @@ class JobBroker:
         for job_id, payload in payloads.items():
             encode({"type": "jobs", "jobs": [{"job_id": job_id, **payload}]})
 
-        def _enqueue():
-            tele = _tele.enabled()
-            now = time.monotonic()
-            for job_id, payload in payloads.items():
-                self._payloads[job_id] = payload
-                self._pending.append(job_id)
-                if tele:
-                    self._tele_enqueued[job_id] = now
-            if tele:
-                self._update_flow_gauges()
-            self._dispatch()
+        self._loop.call_soon_threadsafe(self._enqueue_jobs, dict(payloads), sid)
 
-        self._loop.call_soon_threadsafe(_enqueue)
+    def _enqueue_jobs(self, payloads: Dict[str, Dict[str, Any]], sid: str) -> None:
+        """Loop-thread enqueue: session books, quarantine gate, scheduler.
+
+        Also the wire-client submit path (``_handle_client`` runs in the
+        loop thread and calls this directly).  A session that closed
+        between the caller-side check and this callback records loud
+        terminal failures instead of silently dropping the jobs.
+        """
+        if sid == DEFAULT_SESSION:
+            sess: Optional[SearchSession] = self._registry.ensure_default()
+        else:
+            sess = self._registry.peek(sid)
+        if sess is None or sess.closed:
+            _get_registry().counter("session_rejected_total", session=sid).inc(len(payloads))
+            reason = f"session {sid!r} is {'closed' if sess is not None else 'unknown'}"
+            if sess is not None:
+                sess.rejected += len(payloads)
+            if sess is not None and sess.remote:
+                for job_id in payloads:
+                    self._deliver_remote(sess, {"type": "fail", "session": sid,
+                                                "job_id": job_id, "reason": reason})
+            else:
+                with self._cond:
+                    for job_id in payloads:
+                        self._failures[job_id] = reason
+                    self._cond.notify_all()
+            return
+        tele = _tele.enabled()
+        now = time.monotonic()
+        quarantined: Dict[str, str] = {}
+        for job_id, payload in payloads.items():
+            gk = genome_key(payload.get("genes"))
+            if gk in sess.quarantine:
+                # Poison isolation: this genome already burned its failure
+                # budget in THIS session — fail instantly, never dispatch.
+                sess.rejected += 1
+                quarantined[job_id] = (
+                    f"genome {gk} quarantined in session {sid!r} "
+                    f"after repeated failures")
+                continue
+            if sid != DEFAULT_SESSION:
+                # Tag a COPY: default-session payloads stay byte-identical
+                # to the pre-session wire format, and callers keep their
+                # dicts untouched either way.
+                payload = dict(payload)
+                payload["session"] = sid
+            self._payloads[job_id] = payload
+            self._job_session[job_id] = sid
+            self._job_genome[job_id] = gk
+            self._sched.push(sid, job_id)
+            sess.submitted += 1
+            if tele:
+                self._tele_enqueued[job_id] = now
+        if quarantined:
+            if sess.remote:
+                for job_id, reason in quarantined.items():
+                    self._deliver_remote(sess, {"type": "fail", "session": sid,
+                                                "job_id": job_id, "reason": reason})
+            else:
+                with self._cond:
+                    self._failures.update(quarantined)
+                    self._cond.notify_all()
+        if tele:
+            self._update_flow_gauges()
+        self._dispatch()
 
     def wait_any(
         self, job_ids: List[str], timeout: Optional[float] = None
@@ -494,45 +600,164 @@ class JobBroker:
         ids = set(job_ids)
         if not ids or self._loop is None:
             return
+        self._loop.call_soon_threadsafe(self._cancel_ids, ids)
 
-        def _do():
-            ops = _health.enabled()
+    def _cancel_ids(self, ids: Set[str]) -> None:
+        """Loop-thread cancel body (also the close_session sweep)."""
+        ops = _health.enabled()
+        for j in ids:
+            self._payloads.pop(j, None)
+            self._job_session.pop(j, None)
+            self._job_genome.pop(j, None)
+            self._crash_counts.pop(j, None)
+            self._tele_enqueued.pop(j, None)
+            self._tele_dispatched.pop(j, None)
+            if ops:
+                self._watchdog.job_removed(j)
+        # Drain cancelled ids from the scheduler now: with no worker
+        # connected nothing else pops the queues, and a retry loop would
+        # grow them by one generation per attempt.
+        self._sched.remove(ids)
+        for w in self._workers.values():
+            # Restore the credit _dispatch deducted for cancelled jobs,
+            # so the worker's next batch isn't shrunk for one cycle.
+            cancelled_here = len(w.in_flight & ids)
+            w.in_flight -= ids
+            w.credit = min(w.window, w.credit + cancelled_here)
+        # Late sweep: a result that was mid-delivery when gather pruned
+        # (past the payload check, blocked on _cond) lands in _results
+        # BEFORE this callback runs — handler and callbacks share the
+        # loop thread, and call_soon callbacks queue behind the handler.
+        # Sweeping here therefore removes any such orphan for good.
+        with self._cond:
             for j in ids:
-                self._payloads.pop(j, None)
-                self._tele_enqueued.pop(j, None)
-                self._tele_dispatched.pop(j, None)
-                if ops:
-                    self._watchdog.job_removed(j)
-            if any(j in ids for j in self._pending):
-                # Drain cancelled ids now: with no worker connected nothing
-                # else pops the deque, and a retry loop would grow it by one
-                # generation per attempt.
-                self._pending = deque(j for j in self._pending if j not in ids)
-            for w in self._workers.values():
-                # Restore the credit _dispatch deducted for cancelled jobs,
-                # so the worker's next batch isn't shrunk for one cycle.
-                cancelled_here = len(w.in_flight & ids)
-                w.in_flight -= ids
-                w.credit = min(w.window, w.credit + cancelled_here)
-            # Late sweep: a result that was mid-delivery when gather pruned
-            # (past the payload check, blocked on _cond) lands in _results
-            # BEFORE this callback runs — handler and callbacks share the
-            # loop thread, and call_soon callbacks queue behind the handler.
-            # Sweeping here therefore removes any such orphan for good.
-            with self._cond:
-                for j in ids:
-                    self._results.pop(j, None)
-                    self._failures.pop(j, None)
-                    self._fail_counts.pop(j, None)
-            if _tele.enabled():
-                self._update_flow_gauges()
-
-        self._loop.call_soon_threadsafe(_do)
+                self._results.pop(j, None)
+                self._failures.pop(j, None)
+                self._fail_counts.pop(j, None)
+        if _tele.enabled():
+            self._update_flow_gauges()
 
     def evaluate(self, payloads: Dict[str, Dict[str, Any]], timeout: Optional[float] = None) -> Dict[str, float]:
         """submit + gather in one call."""
         self.submit(payloads)
         return self.gather(list(payloads), timeout=timeout)
+
+    # -- session API (multi-tenant; sessions.py) ---------------------------
+
+    def open_session(self, session_id: Optional[str] = None, weight: float = 1.0,
+                     max_in_flight: Optional[int] = None) -> str:
+        """Open (or re-attach to) a search session and return its id.
+
+        ``weight`` sets the tenant's fair-share priority (a weight-2
+        session gets 2× the dispatch share of a weight-1 neighbor while
+        both are backlogged); ``max_in_flight`` caps how many of its jobs
+        may be dispatched at once regardless of share.  Safe from any
+        thread; idempotent for an open id.
+        """
+        return self._registry.open(session_id, weight=weight,
+                                   max_in_flight=max_in_flight).session_id
+
+    def close_session(self, session_id: str) -> None:
+        """Close a session: no new submits, its queued jobs are withdrawn
+        and its capacity share flows back to the remaining tenants.
+        Idempotent; unknown ids are a no-op (close-after-close races are
+        normal during teardown)."""
+        sid = str(session_id)
+        sess = self._registry.close(sid)
+        if sess is None or self._loop is None or not self._started.is_set():
+            return
+
+        def _do():
+            ids = {j for j, s in self._job_session.items() if s == sid}
+            if ids:
+                self._cancel_ids(ids)
+
+        self._loop.call_soon_threadsafe(_do)
+
+    def session_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-session book snapshot (submitted/completed/failed/rejected/
+        requeued/quarantined, queue depth, in-flight).  Snapshot read —
+        safe from any thread."""
+        inflight = self._inflight_by_session()
+        return {
+            s.session_id: s.snapshot(
+                in_flight=inflight.get(s.session_id, 0),
+                queued=self._sched.session_depth(s.session_id))
+            for s in self._registry.list()
+        }
+
+    def session_capacity(self, session_id: Optional[str] = None) -> int:
+        """This session's share of :meth:`fleet_capacity`.
+
+        With ≤1 open session (or an unknown id — old single-tenant
+        callers) this IS the full fleet capacity.  With concurrent
+        tenants it is the weighted share ``total × w/W`` (min 1 while the
+        fleet is non-empty, so a light tenant always makes progress),
+        clamped by the session's ``max_in_flight`` quota.  The engines'
+        in-flight targets read this instead of the raw fleet sum, so N
+        searches sharing a fleet size themselves to their shares.
+        """
+        total = self.fleet_capacity()
+        sid = str(session_id) if session_id else DEFAULT_SESSION
+        open_s = self._registry.open_sessions()
+        mine = next((s for s in open_s if s.session_id == sid), None)
+        if mine is None or len(open_s) <= 1:
+            cap = total
+        elif total <= 0:
+            cap = 0
+        else:
+            weight_sum = sum(s.weight for s in open_s)
+            cap = max(1, round(total * mine.weight / weight_sum))
+        if mine is not None and mine.max_in_flight is not None:
+            cap = min(cap, mine.max_in_flight)
+        return cap
+
+    def session_prefetch(self, session_id: Optional[str] = None) -> int:
+        """This session's share of :meth:`fleet_prefetch`, proportional
+        like :meth:`session_capacity` and clamped so share + prefetch
+        never exceeds the session's ``max_in_flight`` quota."""
+        total = self.fleet_prefetch()
+        sid = str(session_id) if session_id else DEFAULT_SESSION
+        open_s = self._registry.open_sessions()
+        mine = next((s for s in open_s if s.session_id == sid), None)
+        if mine is None or len(open_s) <= 1:
+            pre = total
+        else:
+            weight_sum = sum(s.weight for s in open_s)
+            pre = int(total * mine.weight / weight_sum)
+        if mine is not None and mine.max_in_flight is not None:
+            pre = max(0, min(pre, mine.max_in_flight - self.session_capacity(sid)))
+        return pre
+
+    def _inflight_by_session(self) -> Dict[str, int]:
+        """Dispatched-unacked job count per session, recomputed from the
+        worker table (no drift-prone counters).  Loop-thread exact; from
+        other threads a snapshot read with one retry against a mid-copy
+        resize, like every other fleet snapshot."""
+        counts: Dict[str, int] = {}
+        for w in list(self._workers.values()):
+            try:
+                held = list(w.in_flight)
+            except RuntimeError:  # pragma: no cover - resized mid-copy
+                held = list(w.in_flight)
+            for job_id in held:
+                sid = self._job_session.get(job_id, DEFAULT_SESSION)
+                counts[sid] = counts.get(sid, 0) + 1
+        return counts
+
+    def _deliver_remote(self, sess: SearchSession, frame: Dict[str, Any]) -> None:
+        """Forward a result/fail frame to a wire tenant (loop thread).
+
+        Detached (or broken) owners get the frame parked in the session's
+        bounded ``undelivered`` queue, flushed on re-attach."""
+        owner = sess.owner
+        if owner is not None:
+            try:
+                owner.write(encode(frame))
+                return
+            except Exception:  # connection died; reader cleanup will detach
+                sess.owner = None
+        sess.undelivered.append(frame)
 
     def fleet_capacity(self) -> int:
         """Total job slots advertised by the LIVE fleet (0 when none).
@@ -603,10 +828,14 @@ class JobBroker:
             results, failures = len(self._results), len(self._failures)
         return {
             "payloads": len(self._payloads),
-            "pending": len(self._pending),
+            "pending": self._sched.depth(),
             "fail_counts": len(self._fail_counts),
             "results": results,
             "failures": failures,
+            # Session tenancy maps share the _payloads lifecycle: nonzero
+            # after a final gather means a pop site was missed.
+            "job_sessions": len(self._job_session),
+            "crash_counts": len(self._crash_counts),
         }
 
     @staticmethod
@@ -643,9 +872,19 @@ class JobBroker:
         reg = _get_registry()
         reg.gauge("jobs_in_flight").set(
             sum(len(w.in_flight) for w in self._workers.values()))
-        depth = len(self._pending)
+        depth = self._sched.depth()
         reg.gauge("queue_depth").set(depth)
         reg.gauge("broker_queue_depth").set(depth)
+        # Per-tenant twins (labels): only emitted once a session table
+        # exists, so single-tenant dashboards see no new series.
+        sessions = self._registry.list()
+        if sessions:
+            inflight = self._inflight_by_session()
+            for s in sessions:
+                sid = s.session_id
+                reg.gauge("session_in_flight", session=sid).set(inflight.get(sid, 0))
+                reg.gauge("session_queue_depth", session=sid).set(
+                    self._sched.session_depth(sid))
         # Dispatched jobs beyond the workers' evaluation capacity are (from
         # the broker's vantage) sitting in worker-local ready-queues — the
         # double-buffering inventory.  Persistently 0 with prefetching
@@ -663,12 +902,31 @@ class JobBroker:
         credit-based prefetch.  The worker never guesses (with a read
         timeout) whether more of its batch is still in flight: a capacity-8
         worker gets its 8 jobs in a single frame whatever the DCN latency.
+
+        Job ORDER comes from the fair-share scheduler: weighted deficit
+        round-robin across sessions, with per-session ``max_in_flight``
+        quotas enforced here (a quota-full session's jobs stay queued and
+        its turn passes to the others — work conservation).
         """
-        if not self._pending:
+        if self._sched.depth() == 0:
             return
         tele = _tele.enabled()
         ops = _health.enabled()
+        # Quota eligibility is computed once and tracked incrementally
+        # through this pass; the next _dispatch recomputes from the worker
+        # table, so the count can never drift.
+        inflight = self._inflight_by_session()
+        quotas = {s.session_id: s.max_in_flight
+                  for s in self._registry.list() if s.max_in_flight is not None}
+
+        def eligible(sid: str) -> bool:
+            quota = quotas.get(sid)
+            return quota is None or inflight.get(sid, 0) < quota
+
+        exhausted = False  # no session has a dispatchable job left
         for w in list(self._workers.values()):
+            if exhausted:
+                break
             if w.draining:  # orderly exit in progress: never hand it work
                 continue
             batch: List[Dict[str, Any]] = []
@@ -678,23 +936,30 @@ class JobBroker:
             # exceed it — flush into multiple `jobs` frames when needed (the
             # client reads frames one per consume-loop iteration).
             soft_cap = MAX_MESSAGE_BYTES // 2
-            while w.credit > 0 and self._pending:
-                job_id = self._pending.popleft()
-                if job_id not in self._payloads:  # already satisfied/failed
-                    continue
+            while w.credit > 0:
+                nxt = self._sched.pop_next(
+                    eligible, lambda j: j in self._payloads)
+                if nxt is None:  # nothing queued, or every session quota-full
+                    exhausted = True
+                    break
+                sid, job_id = nxt
                 w.credit -= 1
                 w.in_flight.add(job_id)
+                inflight[sid] = inflight.get(sid, 0) + 1
                 if tele:
                     # queue_wait: time from (re)enqueue to handoff.  The
                     # stamp stays in place — _on_result uses it for the
                     # end-to-end job span.
+                    attrs = {"worker": w.worker_id}
+                    if sid != DEFAULT_SESSION:
+                        attrs["session"] = sid
                     t_enq = self._tele_enqueued.get(job_id)
                     if t_enq is not None:
                         wait = time.monotonic() - t_enq
                         _tele.record_span(
                             "queue_wait", t_enq, wait,
                             trace=self._payloads[job_id].get("trace"),
-                            attrs={"worker": w.worker_id},
+                            attrs=attrs,
                         )
                         # The registry twin of the span: a per-job wait
                         # histogram dashboards can read without span
@@ -705,7 +970,9 @@ class JobBroker:
                 if ops:
                     # Same clock start as dispatch_rtt_s: the watchdog
                     # measures handoff → now against its rolling threshold.
-                    self._watchdog.job_started(job_id, w.worker_id)
+                    self._watchdog.job_started(
+                        job_id, w.worker_id,
+                        session=sid if sid != DEFAULT_SESSION else None)
                 entry = {"job_id": job_id, **self._payloads[job_id]}
                 entry_bytes = len(encode(entry))
                 if batch and batch_bytes + entry_bytes > soft_cap:
@@ -715,8 +982,6 @@ class JobBroker:
                 batch_bytes += entry_bytes
             if batch:
                 self._send(w, {"type": "jobs", "jobs": batch})
-            if not self._pending:
-                break
         if tele:
             self._update_flow_gauges()
 
@@ -731,17 +996,40 @@ class JobBroker:
     def _requeue_worker_jobs(self, w: _Worker, reason: str) -> None:
         tele = _tele.enabled()
         ops = _health.enabled()
+        crash_cap = self._quarantine_crash_requeues
         for job_id in sorted(w.in_flight):
             if ops:
                 self._watchdog.job_removed(job_id)
             if job_id in self._payloads:
+                sid = self._job_session.get(job_id, DEFAULT_SESSION)
+                if crash_cap is not None and reason == "disconnect":
+                    # Crash isolation (opt-in): a job whose worker keeps
+                    # dying mid-evaluation is most likely KILLING them.
+                    # After crash_cap redeliveries it fails terminally and
+                    # its genome is quarantined in its session, so one
+                    # poison genome cannot crash-loop the fleet for every
+                    # tenant.  Default None = unbounded AMQP redelivery.
+                    n = self._crash_counts.get(job_id, 0) + 1
+                    self._crash_counts[job_id] = n
+                    if n >= crash_cap:
+                        logger.error(
+                            "job %s crashed its worker %d time(s); failing "
+                            "terminally and quarantining its genome", job_id, n)
+                        self._fail_terminal(
+                            job_id,
+                            f"worker crashed {n} time(s) while evaluating",
+                            force_quarantine=True)
+                        continue
                 logger.warning("requeue job %s (%s, worker %s)", job_id, reason, w.worker_id)
                 # Disconnect redelivery is unbounded, like AMQP's.  This
                 # covers the worker's whole in-flight set — the jobs it was
                 # evaluating AND the ones still queued-but-unstarted in its
                 # local prefetch queue (the broker cannot tell them apart,
                 # and at-least-once makes the distinction irrelevant).
-                self._pending.append(job_id)
+                self._sched.push(sid, job_id)
+                sess = self._registry.peek(sid)
+                if sess is not None:
+                    sess.requeued += 1
                 if tele:
                     # Restart the clock: queue_wait/job measure time since
                     # the LAST enqueue, not since first submission.
@@ -750,6 +1038,44 @@ class JobBroker:
         w.in_flight.clear()
         if tele:
             self._update_flow_gauges()
+
+    def _fail_terminal(self, job_id: str, reason: str,
+                       force_quarantine: bool = False) -> None:
+        """Terminal failure: close the job's state, count its genome toward
+        (or force) per-session quarantine, surface the failure to the
+        session's owner.  Loop thread only."""
+        if self._payloads.pop(job_id, None) is None:
+            return
+        sid = self._job_session.pop(job_id, DEFAULT_SESSION)
+        gk = self._job_genome.pop(job_id, None)
+        self._crash_counts.pop(job_id, None)
+        self._fail_counts.pop(job_id, None)
+        self._tele_enqueued.pop(job_id, None)
+        self._tele_dispatched.pop(job_id, None)
+        sess = self._registry.peek(sid)
+        if sess is not None:
+            sess.failed += 1
+            if gk is not None:
+                n = sess.poison_counts.get(gk, 0) + 1
+                sess.poison_counts[gk] = n
+                hit_threshold = force_quarantine or n >= self._registry.quarantine_after
+                if hit_threshold and gk not in sess.quarantine:
+                    sess.quarantine.add(gk)
+                    _get_registry().counter("session_quarantined_total",
+                                            session=sid).inc()
+                    _tele.record_event("genome_quarantined", {
+                        "session": sid, "genome": gk, "terminal_failures": n,
+                        "forced_by_crash": bool(force_quarantine),
+                    })
+        if _tele.enabled():
+            self._update_flow_gauges()
+        if sess is not None and sess.remote:
+            self._deliver_remote(sess, {"type": "fail", "session": sid,
+                                        "job_id": job_id, "reason": reason})
+        else:
+            with self._cond:
+                self._failures[job_id] = reason
+                self._cond.notify_all()
 
     async def _reaper(self) -> None:
         """Declare silent workers holding jobs dead; requeue their jobs."""
@@ -787,7 +1113,7 @@ class JobBroker:
 
     def _requeue_straggler(self, info: Dict[str, Any]) -> None:
         job_id = str(info.get("job_id"))
-        if job_id not in self._payloads or job_id in self._pending:
+        if job_id not in self._payloads or self._sched.queued(job_id):
             return  # finished/cancelled/already requeued since flagging
         holder = next((w for w in self._workers.values() if job_id in w.in_flight), None)
         if holder is None:
@@ -800,15 +1126,21 @@ class JobBroker:
         # new work anyway, and its late result is dropped by the payload
         # membership check like any redelivery duplicate.
         holder.in_flight.discard(job_id)
-        self._pending.append(job_id)
+        sid = self._job_session.get(job_id, DEFAULT_SESSION)
+        self._sched.push(sid, job_id)
+        sess = self._registry.peek(sid)
+        if sess is not None:
+            sess.requeued += 1
         self._watchdog.job_removed(job_id)
         self._tele_dispatched.pop(job_id, None)
         if _tele.enabled():
             self._tele_enqueued[job_id] = time.monotonic()
-        _get_registry().counter(
-            "stragglers_requeued_total", worker=holder.worker_id).inc()
+        labels = {"worker": holder.worker_id}
+        if sid != DEFAULT_SESSION:
+            labels["session"] = sid
+        _get_registry().counter("stragglers_requeued_total", **labels).inc()
         _tele.record_event("straggler_requeued", {
-            "job_id": job_id, "worker_id": holder.worker_id,
+            "job_id": job_id, "worker_id": holder.worker_id, "session": sid,
             "age_s": info.get("age_s"), "threshold_s": info.get("threshold_s"),
         })
         self._dispatch()
@@ -837,12 +1169,15 @@ class JobBroker:
             "draining": sum(1 for x in workers if x["draining"]),
             "live_capacity": self.fleet_capacity(),
             "live_prefetch": self.fleet_prefetch(),
-            "queue_depth": len(self._pending),
+            "queue_depth": self._sched.depth(),
             "open_jobs": len(self._payloads),
             "jobs_in_flight": sum(x["jobs_in_flight"] for x in workers),
             "straggler_threshold_s": round(self._watchdog.threshold(), 3),
             "stragglers": self._watchdog.stragglers(),
             "straggler_requeue": self._straggler_requeue,
+            # Tenant table (empty until the first submit/open_session):
+            # per-session books for the /statusz sessions panel.
+            "sessions": self.session_stats(),
         }
 
     async def _handle_worker(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -864,6 +1199,12 @@ class JobBroker:
                 # credential rejection (terminal) from transient errors.
                 writer.write(encode({"type": "error", "code": "auth", "reason": "bad token"}))
                 logger.warning("worker rejected: bad token")
+                return
+            if str(hello.get("role") or "") == "client":
+                # Session tenant over the wire (protocol.py "Session
+                # messages") — not a worker: no credit, no capacity, no
+                # entry in the fleet table.
+                await self._handle_client(reader, writer)
                 return
             try:
                 n_chips = max(1, int(hello.get("n_chips", 1)))
@@ -992,6 +1333,96 @@ class JobBroker:
                 self._dispatch()
             writer.close()
 
+    async def _handle_client(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        """Wire-tenant connection (``hello`` with ``role="client"``).
+
+        Runs in the broker loop, so session/scheduler mutations go through
+        the same single-threaded paths as worker frames.  A dropped
+        connection DETACHES the client's sessions (results park in their
+        ``undelivered`` queues for re-attach); it does not close them.
+        """
+        writer.write(encode({"type": "welcome"}))
+        attached: Set[str] = set()
+
+        def _reject(sid: Any, reason: str) -> None:
+            # The loud error frame (never a silent drop) + its counter.
+            sid = str(sid)
+            _get_registry().counter("session_rejected_total", session=sid).inc()
+            writer.write(encode({"type": "error", "code": "session",
+                                 "session": sid, "reason": reason}))
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break  # EOF: client gone
+                msg = decode(line)
+                mtype = msg.get("type")
+                if mtype == "session_open":
+                    try:
+                        weight = float(msg.get("weight", 1.0))
+                    except (TypeError, ValueError):
+                        weight = 1.0
+                    quota = msg.get("max_in_flight")
+                    try:
+                        quota = None if quota is None else int(quota)
+                    except (TypeError, ValueError):
+                        quota = None
+                    try:
+                        sess = self._registry.open(
+                            msg.get("session"), weight=weight,
+                            max_in_flight=quota, remote=True)
+                    except UnknownSessionError as e:  # reopening a closed id
+                        _reject(msg.get("session"), str(e))
+                        continue
+                    sess.owner = writer
+                    attached.add(sess.session_id)
+                    # Re-attach: flush results that arrived while detached.
+                    while sess.undelivered:
+                        writer.write(encode(sess.undelivered.popleft()))
+                    writer.write(encode({"type": "session_ok",
+                                         "session": sess.session_id}))
+                elif mtype == "session_detach":
+                    sid = str(msg.get("session"))
+                    sess = self._registry.peek(sid)
+                    if sess is not None and sess.owner is writer:
+                        sess.owner = None
+                    attached.discard(sid)
+                    writer.write(encode({"type": "session_ok", "session": sid}))
+                elif mtype == "session_close":
+                    sid = str(msg.get("session"))
+                    self.close_session(sid)
+                    attached.discard(sid)
+                    writer.write(encode({"type": "session_ok", "session": sid}))
+                elif mtype == "submit":
+                    sid = str(msg.get("session") or DEFAULT_SESSION)
+                    sess = self._registry.peek(sid)
+                    if sess is None or sess.closed:
+                        state = "closed" if sess is not None else "unknown"
+                        if sess is not None:
+                            sess.rejected += len(msg.get("jobs") or ())
+                        _reject(sid, f"session {sid!r} is {state}")
+                        continue
+                    payloads = {}
+                    for job in msg.get("jobs") or ():
+                        job = dict(job)
+                        job_id = str(job.pop("job_id", "") or self.new_job_id())
+                        payloads[job_id] = job
+                    self._enqueue_jobs(payloads, sid)
+                elif mtype == "cancel":
+                    self._cancel_ids({str(j) for j in msg.get("jobs") or ()})
+                elif mtype == "ping":
+                    pass
+                else:
+                    logger.warning("unknown client message type %r", mtype)
+        finally:
+            for sid in attached:
+                sess = self._registry.peek(sid)
+                if sess is not None and sess.owner is writer:
+                    sess.owner = None
+            writer.close()
+
     def _on_result(self, w: _Worker, msg: Dict[str, Any]) -> bool:
         """Record one result; True iff it was fresh (not a stale duplicate)."""
         job_id = str(msg["job_id"])
@@ -1009,6 +1440,12 @@ class JobBroker:
             return False
         payload = self._payloads[job_id]
         del self._payloads[job_id]
+        sid = self._job_session.pop(job_id, DEFAULT_SESSION)
+        self._job_genome.pop(job_id, None)
+        self._crash_counts.pop(job_id, None)
+        sess = self._registry.peek(sid)
+        if sess is not None:
+            sess.completed += 1
         if _health.enabled():
             # Fresh results only (behind the dedup check): a duplicate's
             # RTT would double-sample the watchdog's rolling window.
@@ -1017,12 +1454,15 @@ class JobBroker:
             # Behind the membership check on purpose: a duplicated result
             # frame (chaos: duplicate_result) must not double-ingest the
             # worker's span report either.
+            attrs = {"worker": w.worker_id}
+            if sid != DEFAULT_SESSION:
+                attrs["session"] = sid
             t_enq = self._tele_enqueued.pop(job_id, None)
             if t_enq is not None:
                 dur = time.monotonic() - t_enq
                 _tele.record_span("job", t_enq, dur,
                                   trace=payload.get("trace"),
-                                  attrs={"worker": w.worker_id})
+                                  attrs=attrs)
                 _get_registry().histogram("broker_job_latency_seconds").observe(dur)
             t_disp = self._tele_dispatched.pop(job_id, None)
             if t_disp is not None:
@@ -1034,7 +1474,7 @@ class JobBroker:
                 rtt = time.monotonic() - t_disp
                 _tele.record_span("dispatch_rtt", t_disp, rtt,
                                   trace=payload.get("trace"),
-                                  attrs={"worker": w.worker_id})
+                                  attrs=attrs)
                 _get_registry().histogram("dispatch_rtt_s").observe(rtt)
             reported = msg.get("spans")
             if reported:
@@ -1045,8 +1485,16 @@ class JobBroker:
             # thread, and an unsynchronized read-modify-write here could
             # resurrect a pre-reset total into the next sweep.
             self._chips_seen = max(self._chips_seen, self.fleet_chips())
-            self._results[job_id] = fitness
-            self._cond.notify_all()
+            if sess is None or not sess.remote:
+                self._results[job_id] = fitness
+                self._cond.notify_all()
+        if sess is not None and sess.remote:
+            # Wire tenant: the result belongs to the attached client, not
+            # the in-process results table — forward (or park) the frame.
+            self._deliver_remote(sess, {
+                "type": "results", "session": sid,
+                "results": [{"job_id": job_id, "fitness": fitness}],
+            })
         return True
 
     def _on_fail(self, w: _Worker, msg: Dict[str, Any]) -> None:
@@ -1063,17 +1511,10 @@ class JobBroker:
         self._fail_counts[job_id] = self._fail_counts.get(job_id, 0) + 1
         if self._fail_counts[job_id] >= self._max_attempts:
             logger.error("job %s failed %d times: %s", job_id, self._fail_counts[job_id], reason)
-            del self._payloads[job_id]
-            self._tele_enqueued.pop(job_id, None)
-            self._tele_dispatched.pop(job_id, None)
-            if _tele.enabled():
-                self._update_flow_gauges()
-            with self._cond:
-                self._failures[job_id] = reason
-                self._cond.notify_all()
+            self._fail_terminal(job_id, reason)
         else:
             logger.warning("job %s failed (%s); requeueing", job_id, reason)
-            self._pending.append(job_id)
+            self._sched.push(self._job_session.get(job_id, DEFAULT_SESSION), job_id)
             self._tele_dispatched.pop(job_id, None)
             if _tele.enabled():
                 self._tele_enqueued[job_id] = time.monotonic()
@@ -1105,7 +1546,11 @@ class JobBroker:
             if job_id not in w.in_flight or job_id not in self._payloads:
                 continue  # finished/cancelled since the worker queued it
             w.in_flight.discard(job_id)
-            self._pending.append(job_id)
+            sid = self._job_session.get(job_id, DEFAULT_SESSION)
+            self._sched.push(sid, job_id)
+            sess = self._registry.peek(sid)
+            if sess is not None:
+                sess.requeued += 1
             if ops:
                 self._watchdog.job_removed(job_id)
             self._tele_dispatched.pop(job_id, None)

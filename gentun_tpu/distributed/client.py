@@ -764,8 +764,14 @@ class GentunClient:
                     # wrapper plus Population.evaluate's nested `train` and
                     # any model-level compile/train/eval), and ship them
                     # home in the first result frame of the group.
+                    eval_attrs: Dict[str, Any] = {"jobs": len(individuals)}
+                    # Tenant attribution (protocol.py "Session messages"):
+                    # a session-tagged group labels its worker-side spans.
+                    session = ok_jobs[0].get("session")
+                    if session:
+                        eval_attrs["session"] = str(session)
                     with _tele.attach(ok_jobs[0].get("trace")), _tele.capture() as captured:
-                        with _tele.span("eval", {"jobs": len(individuals)}):
+                        with _tele.span("eval", eval_attrs):
                             pop.evaluate()
                     for rec in captured:
                         rec.setdefault("src", self.worker_id)
@@ -778,7 +784,12 @@ class GentunClient:
                     )
                 entries = []
                 for job, ind in zip(ok_jobs, individuals):
-                    entries.append({"job_id": job["job_id"], "fitness": ind.get_fitness()})
+                    entry = {"job_id": job["job_id"], "fitness": ind.get_fitness()}
+                    if job.get("session"):
+                        # Echo the tenant tag (OPTIONAL; the broker keys on
+                        # job_id — the echo is for wire-level attribution).
+                        entry["session"] = job["session"]
+                    entries.append(entry)
                     self._jobs_done += 1
                 if self._is_leader and entries:
                     # The whole capacity window acks as ONE `results` frame

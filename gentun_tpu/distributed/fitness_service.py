@@ -528,9 +528,11 @@ class ServiceBackedCache(dict):
     """
 
     def __init__(self, client: FitnessServiceClient,
-                 seed: Optional[Dict[Any, float]] = None):
+                 seed: Optional[Dict[Any, float]] = None,
+                 namespace: Optional[str] = None):
         super().__init__(seed or {})
         self.client = client
+        self.namespace = str(namespace) if namespace else None
         self._wire_keys: Dict[Any, Optional[str]] = {}
 
     def _wire_key(self, key: Any) -> Optional[str]:
@@ -538,6 +540,11 @@ class ServiceBackedCache(dict):
             wk = self._wire_keys[key]
         except KeyError:
             wk = wire_key(key)
+            # An explicit namespace opts a tenant OUT of cross-tenant
+            # dedup: its service entries live under a disjoint key prefix.
+            # Default (None) keeps content-addressed sharing on.
+            if wk is not None and self.namespace is not None:
+                wk = f"{self.namespace}/{wk}"
             self._wire_keys[key] = wk
         except TypeError:  # unhashable key: nothing upstream produces one,
             return None    # but a cache must never crash a search

@@ -78,6 +78,44 @@ Multi-fidelity field (same OPTIONAL-with-conservative-default convention):
   the field entirely — the fitness-cache keys on the master still keep
   rungs disjoint, the tag only adds fleet-side detection.
 
+Session messages (multi-tenant search sessions, ``sessions.py`` — same
+OPTIONAL convention; every pre-session frame stays byte-identical, so old
+workers and old single-tenant masters interoperate unchanged):
+
+- ``hello`` may carry ``role: "client"``: the connection is a wire TENANT
+  rather than a worker — it submits jobs into a session and receives that
+  session's results, but never evaluates.  After ``welcome`` the broker
+  accepts from it:
+
+  - ``session_open`` {session?, weight?, max_in_flight?} → ``session_ok``
+    {session}: create a search session (or RE-ATTACH to an open one —
+    idempotent, and buffered results are flushed on re-attach).  Omitting
+    ``session`` lets the broker mint an id.
+  - ``session_detach`` {session} → ``session_ok``: stop receiving the
+    session's results; they park in a bounded broker-side queue until
+    someone re-attaches.  The session stays open.
+  - ``session_close`` {session} → ``session_ok``: no further submits; the
+    session's queued jobs are withdrawn and its fair-share slot is
+    released.  Idempotent.
+  - ``submit`` {session, jobs: [{job_id, genes, ...}, ...]}: enqueue jobs
+    into the session (client-supplied job ids).  Results come back as
+    ``results`` frames carrying ``session``, terminal failures as ``fail``
+    frames carrying ``session``.
+  - ``cancel`` {jobs: [job_id, ...]}: withdraw still-open jobs.
+
+- a ``submit`` naming an UNKNOWN or CLOSED session is answered with a
+  structured ``error`` {code: "session", session, reason} frame — loudly,
+  never a silent drop — and bumps the ``session_rejected_total{session}``
+  counter.  In-process submitters get the same contract as an
+  ``UnknownSessionError`` raised from ``JobBroker.submit``.
+- each ``jobs`` entry dispatched from a NON-default session carries
+  ``session``: the tenant tag, echoed by session-aware workers in their
+  result entries (the broker keys on ``job_id``, so an old worker that
+  drops the field loses nothing — the tag exists for worker-side
+  telemetry attribution).  Default-session jobs carry no ``session``
+  field at all: the single-tenant wire format is byte-identical to
+  pre-session brokers.
+
 Telemetry fields (``gentun_tpu/telemetry``, docs/OBSERVABILITY.md) — both
 OPTIONAL and only present when tracing is enabled on the sending side;
 receivers that don't understand them ignore them, so mixed

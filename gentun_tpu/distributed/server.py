@@ -30,6 +30,7 @@ from ..telemetry import health as _health
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .broker import GatherTimeout, JobBroker, JobFailed
+from .sessions import DEFAULT_SESSION
 
 __all__ = ["DistributedPopulation", "DistributedGridPopulation"]
 
@@ -89,6 +90,24 @@ class DistributedPopulation(Population):
       watchdog tuning for an owned broker (``telemetry/health.py``; active
       only while the ops plane is on — see docs/OBSERVABILITY.md "Live ops
       plane").  Ignored when sharing an external ``broker``.
+    - ``session``: multi-tenant search sessions (``distributed/sessions.py``,
+      DISTRIBUTED.md "Multi-tenant search sessions").  Naming a session
+      opens it on the broker (idempotent) and tags every job this
+      population ships with it; ``fleet_capacity``/``fleet_prefetch``
+      then report THIS session's fair share of the fleet, so N engines
+      sharing one broker via ``broker=`` size themselves to their shares
+      with no engine changes.  ``None`` (default) rides the implicit
+      single-tenant session — byte-identical pre-session behavior.
+    - ``session_weight``/``session_quota``: the session's fair-share
+      priority and optional hard in-flight cap (only meaningful with
+      ``session``).
+    - ``cache_namespace``: optional per-session key prefix for the shared
+      fitness service (only meaningful with ``cache_url``).  The DEFAULT
+      is no namespace — cross-tenant dedup stays ON, because cache keys
+      are content-addressed (a fitness is a property of the genome, not
+      the tenant; quotas govern compute, not cache hits).  Set it only to
+      ISOLATE a tenant whose measurements must not be shared (different
+      data, incompatible species).
     """
 
     def __init__(
@@ -120,6 +139,10 @@ class DistributedPopulation(Population):
         straggler_floor_s: float = 30.0,
         straggler_k: float = 4.0,
         straggler_requeue: bool = False,
+        session: Optional[str] = None,
+        session_weight: float = 1.0,
+        session_quota: Optional[int] = None,
+        cache_namespace: Optional[str] = None,
     ):
         if failed_policy not in ("raise", "penalize"):
             raise ValueError(f"unknown failed_policy {failed_policy!r}")
@@ -137,6 +160,7 @@ class DistributedPopulation(Population):
                 for k, v in loaded.items():
                     fitness_cache.setdefault(k, v)
         self.cache_url = cache_url
+        self.cache_namespace = cache_namespace
         self._cache_client = None
         self._cache_status_fn = None
         if cache_url:
@@ -147,7 +171,8 @@ class DistributedPopulation(Population):
             # side (they stay local; only new measurements publish).  The
             # wrapper IS the fitness_cache from here on — clones share it
             # by identity like any cache dict.
-            fitness_cache = ServiceBackedCache(self._cache_client, fitness_cache)
+            fitness_cache = ServiceBackedCache(self._cache_client, fitness_cache,
+                                               namespace=cache_namespace)
             cache = fitness_cache
             # One callable object for register AND unregister (removal is
             # identity-checked); closed over the cache, not self, so any
@@ -191,6 +216,18 @@ class DistributedPopulation(Population):
                 straggler_requeue=straggler_requeue,
             ).start()
             self._owns_broker = True
+        # Session tenancy: an explicit session is opened on the broker
+        # (idempotent — a clone or a reconnecting master re-attaches) and
+        # tags every submit from this population.  _session_arg stays None
+        # for the implicit default so submits stay untagged (and the
+        # default session is only lazily created broker-side).
+        self._session_arg = str(session) if session else None
+        self.session = self._session_arg or DEFAULT_SESSION
+        self.session_weight = float(session_weight)
+        self.session_quota = session_quota
+        if self._session_arg is not None:
+            self.broker.open_session(self._session_arg, weight=session_weight,
+                                     max_in_flight=session_quota)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -215,6 +252,11 @@ class DistributedPopulation(Population):
                 # Flush the write-behind queue so the LAST generation's
                 # measurements reach the service too, then stop the flusher.
                 self._cache_client.close()
+            if self._session_arg is not None and not self._owns_broker:
+                # Release this tenant's slot on the SHARED broker so its
+                # fair-share weight stops diluting the neighbors.  (An
+                # owned broker is stopping anyway; idempotent either way.)
+                self.broker.close_session(self._session_arg)
             if self._owns_broker:
                 self.broker.stop()
 
@@ -232,11 +274,17 @@ class DistributedPopulation(Population):
     # here so the wire format has exactly one owner for both modes.
 
     def fleet_capacity(self) -> int:
-        """Total job slots the connected workers advertise (0 when none)."""
-        return self.broker.fleet_capacity()
+        """THIS session's share of the fleet's job slots (0 when none).
+
+        Single-tenant populations (no ``session``) see the full fleet
+        total, exactly as before; concurrent tenants see their weighted
+        share, which is how unmodified engines size their in-flight
+        targets to coexist on one fleet.
+        """
+        return self.broker.session_capacity(self._session_arg)
 
     def fleet_prefetch(self) -> int:
-        """Total prefetch-queue slots the fleet advertises beyond capacity.
+        """This session's share of the fleet's prefetch slots.
 
         The engine's breed-ahead target is ``fleet_capacity() +
         fleet_prefetch()`` — enough in-flight work that every worker holds
@@ -244,7 +292,7 @@ class DistributedPopulation(Population):
         fleet of old or ``prefetch_depth=0`` workers, which keeps the
         pre-pipelining in-flight target (and trajectories) unchanged.
         """
-        return self.broker.fleet_prefetch()
+        return self.broker.session_prefetch(self._session_arg)
 
     def submit_individuals(self, individuals: Sequence[Individual]) -> List[str]:
         """Ship evaluation jobs without waiting; returns aligned job ids.
@@ -274,7 +322,7 @@ class DistributedPopulation(Population):
             payloads[job_id] = payload
             ids.append(job_id)
         if payloads:
-            self.broker.submit(payloads)
+            self.broker.submit(payloads, session=self._session_arg)
         return ids
 
     def wait_any_results(self, job_ids: Sequence[str], timeout: Optional[float] = None):
@@ -429,7 +477,7 @@ class DistributedPopulation(Population):
             if ctx is not None:
                 for payload in payloads.values():
                     payload["trace"] = ctx
-        self.broker.submit(payloads)
+        self.broker.submit(payloads, session=self._session_arg)
         # Speculative jobs don't count as population work: the GA's
         # individuals/hour metric stays a statement about real individuals.
         return self._gather_apply(real_ids, by_id, dup_map)
@@ -534,7 +582,7 @@ class DistributedPopulation(Population):
             if ctx is not None:
                 for payload in payloads.values():
                     payload["trace"] = ctx
-        self.broker.submit(payloads)
+        self.broker.submit(payloads, session=self._session_arg)
         self._pre = (by_id, dup_map)
         logger.info("pre-dispatched %d job(s) for the next generation", len(payloads))
         return len(payloads)
@@ -616,7 +664,13 @@ class DistributedPopulation(Population):
             evaluate_retries=self.evaluate_retries,
             failed_policy=self.failed_policy,
             speculative_fill=self.speculative_fill,
+            # Session tenancy rides clones: re-opening is an idempotent
+            # attach, so every generation keeps the same tag and share.
+            session=self._session_arg,
+            session_weight=self.session_weight,
+            session_quota=self.session_quota,
         )
+        clone.cache_namespace = self.cache_namespace
         # Carry the store path WITHOUT reloading the file every generation:
         # the clone shares this population's cache dict already.
         clone.fitness_store = self.fitness_store

@@ -1,0 +1,519 @@
+"""Multi-tenant search sessions: one broker, many concurrent searches.
+
+PRs 1-7 built every plane — chaos, telemetry, async engine, pipelined
+dispatch, live ops, ASHA, elastic fleet + shared fitness cache — under the
+assumption that exactly ONE search owns the broker.  This module removes
+that assumption, the system shape ASHA (Li et al., MLSys 2020) was built
+for: many concurrent tuning jobs sharing one elastic worker pool (Real et
+al., ICML 2017 likewise ran many evolution experiments against one fleet).
+
+Three pieces, all consumed by ``broker.JobBroker``:
+
+- :class:`SessionRegistry` / :class:`SearchSession` — the tenant table.
+  Old single-tenant masters never touch it: their jobs ride an IMPLICIT
+  default session (:data:`DEFAULT_SESSION`) that is created lazily on
+  first untagged submit, keeping every pre-session code path — and wire
+  frame — byte-identical.  Tenants attach in-process via
+  ``JobBroker.open_session`` / ``DistributedPopulation(session=...)`` or
+  over the wire via the OPTIONAL client-role messages (protocol.py
+  "Session messages").
+- :class:`FairShareScheduler` — a weighted deficit-round-robin queue that
+  replaces the broker's single FIFO deque.  Unit job cost (every job is
+  one evaluation slot), per-session weights (a weight-2 tenant gets 2× the
+  dispatch share of a weight-1 tenant while both are backlogged), and
+  work-conservation (an idle tenant's share flows to the backlogged ones
+  instead of going unused).  With a single active session it degenerates
+  to exactly the old FIFO order.
+- :class:`SessionClient` — a blocking TCP client for the wire session
+  messages, used by out-of-process tenants (and the session tests): open
+  a session, submit tagged jobs, receive results/failures for your own
+  session only.
+
+Poison-genome isolation lives in the registry: a genome whose evaluation
+terminally fails ``quarantine_after`` times within one session is
+quarantined FOR THAT SESSION — later submits of it fail instantly without
+touching a worker — while other sessions keep their own independent
+verdicts (a genome that crashes tenant A's species may be perfectly fine
+for tenant B's).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
+
+from .protocol import MAX_MESSAGE_BYTES, AuthError, decode, encode
+
+__all__ = [
+    "DEFAULT_SESSION",
+    "SearchSession",
+    "SessionRegistry",
+    "FairShareScheduler",
+    "SessionClient",
+    "UnknownSessionError",
+    "genome_key",
+]
+
+#: The implicit single-tenant session.  Jobs submitted without a session
+#: ride it, its frames carry NO session field (byte-identical to the
+#: pre-session wire format), and it is created lazily — so a broker that
+#: only ever serves tenant sessions never counts it as a capacity sharer.
+DEFAULT_SESSION = "default"
+
+
+class UnknownSessionError(ValueError):
+    """A submit named a session that was never opened, or one already
+    closed.  Loud by design (satellite of ISSUE 8): silently dropping a
+    mis-addressed job would strand its ``gather``/``wait_any`` forever."""
+
+
+def genome_key(genes: Any) -> str:
+    """Content address for a genome within the quarantine table.
+
+    64-bit blake2b over the canonical (sorted-key) JSON of the genes —
+    the same hash family and width as ``utils/fitness_store.key_digest``.
+    Genes that don't survive JSON fall back to ``repr`` so a quarantine
+    verdict still sticks to the exact value that crashed the worker.
+    """
+    try:
+        blob = json.dumps(genes, sort_keys=True, separators=(",", ":"))
+    except (TypeError, ValueError):
+        blob = repr(genes)
+    return hashlib.blake2b(blob.encode("utf-8"), digest_size=8).hexdigest()
+
+
+class SearchSession:
+    """One tenant's state: identity, fair-share weight, quota, books.
+
+    Mutated from the broker loop thread (counters, quarantine) and read
+    as snapshots from master/HTTP threads — the same discipline as
+    ``_Worker``.  ``owner`` is the asyncio writer of the wire client
+    currently attached (None for in-process tenants and detached wire
+    tenants); results for a remote session are forwarded to it, or parked
+    in ``undelivered`` (bounded) until re-attach.
+    """
+
+    __slots__ = ("session_id", "weight", "max_in_flight", "remote", "closed",
+                 "created_at", "submitted", "completed", "failed", "rejected",
+                 "requeued", "poison_counts", "quarantine", "owner",
+                 "undelivered")
+
+    def __init__(self, session_id: str, weight: float = 1.0,
+                 max_in_flight: Optional[int] = None, remote: bool = False):
+        self.session_id = session_id
+        self.weight = max(1e-6, float(weight))
+        self.max_in_flight = None if max_in_flight is None else max(1, int(max_in_flight))
+        self.remote = remote
+        self.closed = False
+        self.created_at = time.monotonic()
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.requeued = 0
+        #: genome_key -> terminal-failure count within THIS session.
+        self.poison_counts: Dict[str, int] = {}
+        #: genome keys this session refuses to dispatch again.
+        self.quarantine: Set[str] = set()
+        self.owner = None
+        self.undelivered: Deque[Dict[str, Any]] = deque(maxlen=10_000)
+
+    def snapshot(self, in_flight: int = 0, queued: int = 0) -> Dict[str, Any]:
+        return {
+            "session": self.session_id,
+            "weight": self.weight,
+            "max_in_flight": self.max_in_flight,
+            "remote": self.remote,
+            "closed": self.closed,
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "rejected": self.rejected,
+            "requeued": self.requeued,
+            "quarantined": len(self.quarantine),
+            "in_flight": in_flight,
+            "queued": queued,
+        }
+
+
+class SessionRegistry:
+    """The tenant table.  All methods are thread-safe (one lock around a
+    dict); the broker loop holds no session references across awaits, so
+    the lock is never contended for long."""
+
+    def __init__(self, quarantine_after: int = 3):
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, SearchSession] = {}
+        self.quarantine_after = max(1, int(quarantine_after))
+
+    def open(self, session_id: Optional[str] = None, weight: float = 1.0,
+             max_in_flight: Optional[int] = None,
+             remote: bool = False) -> SearchSession:
+        """Create a session, or ATTACH to an existing open one (idempotent
+        — re-opening updates weight/quota in place, so a reconnecting
+        tenant re-asserts its priority).  Re-opening a CLOSED id raises:
+        its quarantine verdicts and books are gone, and silently recycling
+        the name would mis-attribute them."""
+        sid = str(session_id) if session_id else uuid.uuid4().hex[:12]
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                if sess.closed:
+                    raise UnknownSessionError(f"session {sid!r} is closed")
+                sess.weight = max(1e-6, float(weight))
+                sess.max_in_flight = (None if max_in_flight is None
+                                      else max(1, int(max_in_flight)))
+                return sess
+            sess = SearchSession(sid, weight=weight,
+                                 max_in_flight=max_in_flight, remote=remote)
+            self._sessions[sid] = sess
+            return sess
+
+    def ensure_default(self) -> SearchSession:
+        """The implicit session, created on first untagged submit only —
+        so a broker serving explicit tenants never counts "default" as a
+        capacity sharer."""
+        with self._lock:
+            sess = self._sessions.get(DEFAULT_SESSION)
+            if sess is None:
+                sess = SearchSession(DEFAULT_SESSION)
+                self._sessions[DEFAULT_SESSION] = sess
+            return sess
+
+    def peek(self, session_id: str) -> Optional[SearchSession]:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def close(self, session_id: str) -> Optional[SearchSession]:
+        """Mark closed (no new submits; excluded from capacity shares).
+        The broker cancels the session's open jobs separately."""
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is not None:
+                sess.closed = True
+                sess.owner = None
+            return sess
+
+    def weight(self, session_id: str) -> float:
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            return sess.weight if sess is not None else 1.0
+
+    def list(self) -> List[SearchSession]:
+        with self._lock:
+            return list(self._sessions.values())
+
+    def open_sessions(self) -> List[SearchSession]:
+        with self._lock:
+            return [s for s in self._sessions.values() if not s.closed]
+
+
+class FairShareScheduler:
+    """Weighted deficit round-robin over per-session FIFO queues.
+
+    Unit job cost: each dispatch slot costs one deficit credit.  When no
+    backlogged+eligible session holds a full credit, every candidate is
+    replenished by ``weight / min(candidate weights)`` — so the lightest
+    candidate gains exactly 1 per round and a weight-2 session gains 2,
+    yielding 2:1 dispatch shares while both stay backlogged.  A session
+    whose queue empties forfeits its deficit (work conservation: you
+    cannot bank priority while idle), and with ONE active session the
+    scheduler is exactly the old single FIFO deque.
+
+    Not thread-safe by itself — owned by the broker loop thread, exactly
+    like the deque it replaces.  ``depth``/``session_depth``/``queued``
+    are len()/membership snapshot reads, safe from any thread.
+    """
+
+    def __init__(self, weight_of: Callable[[str], float]):
+        self._weight_of = weight_of
+        self._queues: Dict[str, Deque[str]] = {}
+        self._order: Deque[str] = deque()  # rotation over backlogged sessions
+        self._deficit: Dict[str, float] = {}
+        self._session_of: Dict[str, str] = {}  # job_id -> session
+
+    def push(self, session_id: str, job_id: str) -> None:
+        q = self._queues.get(session_id)
+        if q is None:
+            q = self._queues[session_id] = deque()
+        if not q:
+            self._order.append(session_id)
+            self._deficit.setdefault(session_id, 0.0)
+        q.append(job_id)
+        self._session_of[job_id] = session_id
+
+    def _drop_session(self, sid: str) -> None:
+        self._queues.pop(sid, None)
+        self._deficit.pop(sid, None)
+        try:
+            self._order.remove(sid)
+        except ValueError:
+            pass
+
+    def pop_next(
+        self,
+        eligible: Callable[[str], bool],
+        valid: Callable[[str], bool],
+    ) -> Optional[Tuple[str, str]]:
+        """The next ``(session, job_id)`` to dispatch, or None when every
+        backlogged session is ineligible (quota) or nothing is queued.
+
+        ``valid`` filters dead jobs (cancelled while queued): invalid ids
+        are discarded WITHOUT charging the session's deficit — a cancelled
+        job must not cost its tenant a dispatch turn.
+        """
+        while True:
+            candidates = [sid for sid in self._order
+                          if self._queues.get(sid) and eligible(sid)]
+            if not candidates:
+                return None
+            chosen = next((sid for sid in candidates
+                           if self._deficit.get(sid, 0.0) >= 1.0), None)
+            if chosen is None:
+                # Replenish one quantum, normalized so the lightest
+                # candidate gains exactly 1 — guarantees progress without
+                # letting a heavy session burst more than its ratio.
+                min_w = min(max(1e-6, self._weight_of(sid)) for sid in candidates)
+                for sid in candidates:
+                    self._deficit[sid] = (self._deficit.get(sid, 0.0)
+                                          + max(1e-6, self._weight_of(sid)) / min_w)
+                continue
+            q = self._queues[chosen]
+            while q:
+                job_id = q.popleft()
+                self._session_of.pop(job_id, None)
+                if not valid(job_id):
+                    continue  # cancelled while queued: free, no deficit cost
+                self._deficit[chosen] -= 1.0
+                # Rotate the served session to the back so equal-weight
+                # tenants interleave instead of draining one at a time.
+                try:
+                    self._order.remove(chosen)
+                except ValueError:  # pragma: no cover - defensive
+                    pass
+                if q:
+                    self._order.append(chosen)
+                else:
+                    self._drop_session(chosen)
+                return chosen, job_id
+            # Queue emptied without a valid job: forfeit deficit, retry.
+            self._drop_session(chosen)
+
+    def remove(self, job_ids: Set[str]) -> None:
+        """Withdraw queued jobs (cancel path).  Eager rebuild of only the
+        affected sessions' queues — queues are one generation deep."""
+        affected: Set[str] = set()
+        for job_id in job_ids:
+            sid = self._session_of.pop(job_id, None)
+            if sid is not None:
+                affected.add(sid)
+        for sid in affected:
+            q = self._queues.get(sid)
+            if q is None:
+                continue
+            kept = deque(j for j in q if j not in job_ids)
+            if kept:
+                self._queues[sid] = kept
+            else:
+                self._drop_session(sid)
+
+    def clear_session(self, session_id: str) -> List[str]:
+        """Drop every queued job of one session (close path); returns the
+        withdrawn job ids."""
+        q = self._queues.get(session_id)
+        ids = list(q) if q else []
+        for job_id in ids:
+            self._session_of.pop(job_id, None)
+        self._drop_session(session_id)
+        return ids
+
+    def queued(self, job_id: str) -> bool:
+        return job_id in self._session_of
+
+    def depth(self) -> int:
+        return len(self._session_of)
+
+    def session_depth(self, session_id: str) -> int:
+        q = self._queues.get(session_id)
+        return len(q) if q else 0
+
+
+class SessionClient:
+    """Blocking TCP client for the wire session messages (protocol.py
+    "Session messages"): an out-of-process tenant's handle on a shared
+    broker.
+
+    One socket, one background reader thread collecting ``results`` /
+    ``fail`` / ``error`` frames into a condition-guarded table —
+    :meth:`wait_any` mirrors ``JobBroker.wait_any`` semantics so tenant
+    code reads the same whichever side of the wire it runs on.
+    """
+
+    def __init__(self, host: str, port: int, token: Optional[str] = None,
+                 timeout: float = 10.0):
+        self.host, self.port, self.token = host, int(port), token
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self._sock.settimeout(None)
+        self._rfile = self._sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self._cond = threading.Condition()
+        self._results: Dict[str, float] = {}
+        self._failures: Dict[str, str] = {}
+        self._errors: Deque[Dict[str, Any]] = deque(maxlen=100)
+        #: monotonically counts error frames ever parked — lets a reply
+        #: wait ignore stale errors from earlier (async) submits.
+        self._error_seq = 0
+        self._replies: Deque[Dict[str, Any]] = deque()
+        self._closed = False
+        self._send({"type": "hello", "role": "client", "token": token})
+        reply = self._recv_direct()
+        if reply.get("type") != "welcome":
+            if reply.get("type") == "error" and reply.get("code") == "auth":
+                raise AuthError(f"broker rejected client: {reply.get('reason')}")
+            raise ConnectionError(f"broker rejected client: {reply}")
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="gentun-session-client", daemon=True)
+        self._reader.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send(self, msg: Dict[str, Any]) -> None:
+        with self._wlock:
+            self._sock.sendall(encode(msg))
+
+    def _recv_direct(self) -> Dict[str, Any]:
+        line = self._rfile.readline(MAX_MESSAGE_BYTES + 2)
+        if not line:
+            raise ConnectionError("broker closed connection")
+        return decode(line)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = self._recv_direct()
+                with self._cond:
+                    mtype = msg.get("type")
+                    if mtype == "results":
+                        for entry in msg.get("results", ()):
+                            try:
+                                self._results[str(entry["job_id"])] = float(entry["fitness"])
+                            except (KeyError, TypeError, ValueError):
+                                continue
+                    elif mtype == "fail":
+                        self._failures[str(msg.get("job_id"))] = str(msg.get("reason", "unknown"))
+                    elif mtype == "error":
+                        self._errors.append(msg)
+                        self._error_seq += 1
+                    else:  # session_ok and friends
+                        self._replies.append(msg)
+                    self._cond.notify_all()
+        except (ConnectionError, OSError, ValueError):
+            with self._cond:
+                self._closed = True
+                self._cond.notify_all()
+
+    def _await_reply(self, rtype: str, timeout: float = 10.0,
+                     since: int = 0, session: Optional[str] = None
+                     ) -> Dict[str, Any]:
+        """Wait for a ``rtype`` frame.  Only error frames parked AFTER
+        ``since`` (the error-seq snapshot taken before the request was
+        sent) and addressed to ``session`` can fail the wait — stale
+        errors from earlier fire-and-forget submits stay in the
+        :meth:`last_error` buffer where they belong."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                for i, msg in enumerate(self._replies):
+                    if msg.get("type") == rtype:
+                        del self._replies[i]
+                        return msg
+                if self._error_seq > since:
+                    fresh = list(self._errors)[-(self._error_seq - since):]
+                    for msg in fresh:
+                        if (msg.get("code") == "session"
+                                and (session is None
+                                     or msg.get("session") == session)):
+                            raise UnknownSessionError(str(msg.get("reason")))
+                if self._closed:
+                    raise ConnectionError("broker connection lost")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"no {rtype!r} reply within {timeout}s")
+                self._cond.wait(timeout=min(remaining, 0.5))
+
+    # -- tenant API --------------------------------------------------------
+
+    def open_session(self, session_id: Optional[str] = None, weight: float = 1.0,
+                     max_in_flight: Optional[int] = None) -> str:
+        msg: Dict[str, Any] = {"type": "session_open", "weight": float(weight)}
+        if session_id:
+            msg["session"] = str(session_id)
+        if max_in_flight is not None:
+            msg["max_in_flight"] = int(max_in_flight)
+        with self._cond:
+            since = self._error_seq
+        self._send(msg)
+        return str(self._await_reply(
+            "session_ok", since=since,
+            session=str(session_id) if session_id else None)["session"])
+
+    def close_session(self, session_id: str) -> None:
+        with self._cond:
+            since = self._error_seq
+        self._send({"type": "session_close", "session": str(session_id)})
+        self._await_reply("session_ok", since=since, session=str(session_id))
+
+    def detach(self, session_id: str) -> None:
+        """Stop receiving this session's results (they park broker-side in
+        the session's bounded undelivered queue until someone re-attaches)."""
+        with self._cond:
+            since = self._error_seq
+        self._send({"type": "session_detach", "session": str(session_id)})
+        self._await_reply("session_ok", since=since, session=str(session_id))
+
+    def submit(self, session_id: str, payloads: Dict[str, Dict[str, Any]]) -> List[str]:
+        """Ship jobs into a session; returns the job ids (caller-supplied
+        keys).  A rejected session surfaces via :meth:`wait_any` failures
+        or :meth:`last_error` — the error frame is asynchronous."""
+        jobs = [{"job_id": job_id, **payload} for job_id, payload in payloads.items()]
+        self._send({"type": "submit", "session": str(session_id), "jobs": jobs})
+        return [str(j["job_id"]) for j in jobs]
+
+    def wait_any(self, job_ids: List[str], timeout: Optional[float] = None
+                 ) -> Tuple[Dict[str, float], Dict[str, str]]:
+        """Block until ≥1 of ``job_ids`` is terminal; ``(results, failures)``
+        drained from the client table (same contract as the broker's)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        want = set(job_ids)
+        with self._cond:
+            while True:
+                done_r = {j: self._results.pop(j) for j in list(want)
+                          if j in self._results}
+                done_f = {j: self._failures.pop(j) for j in list(want)
+                          if j in self._failures}
+                if done_r or done_f:
+                    return done_r, done_f
+                if self._closed:
+                    raise ConnectionError("broker connection lost")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return {}, {}
+                self._cond.wait(timeout=min(remaining, 0.5) if remaining is not None else 0.5)
+
+    def last_error(self) -> Optional[Dict[str, Any]]:
+        """The most recent structured ``error`` frame, if any (satellite:
+        unknown-session submits answer with one instead of silence)."""
+        with self._cond:
+            return self._errors[-1] if self._errors else None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -44,6 +44,8 @@ __all__ = [
     "heartbeats",
     "register_status_provider",
     "unregister_status_provider",
+    "register_engine_status",
+    "unregister_engine_status",
     "status_snapshot",
     "register_watchdog",
     "unregister_watchdog",
@@ -64,6 +66,11 @@ _providers: Dict[str, Callable[[], Any]] = {}
 # Watchdogs whose flagged stragglers gate /healthz (brokers register
 # theirs on start(), unregister on stop()).
 _watchdogs: List["StallWatchdog"] = []
+# session -> engine snapshot callable.  A keyed registry instead of the
+# last-wins "engine" provider slot: two engines sharing a broker (multi-
+# tenant sessions) each get a row in /statusz instead of overwriting
+# each other.
+_engines: Dict[str, Callable[[], Any]] = {}
 
 
 def enabled() -> bool:
@@ -93,6 +100,7 @@ def reset() -> None:
     with _lock:
         _sources.clear()
         _providers.clear()
+        _engines.clear()
         del _watchdogs[:]
 
 
@@ -159,6 +167,56 @@ def unregister_status_provider(name: str, fn: Optional[Callable[[], Any]] = None
     with _lock:
         if fn is None or _providers.get(name) is fn:
             _providers.pop(name, None)
+
+
+def register_engine_status(session: str, fn: Callable[[], Any]) -> None:
+    """Install an ENGINE snapshot callable, keyed by its search session.
+
+    The old contract — ``register_status_provider("engine", fn)`` — was
+    last-wins: two engines sharing a broker (multi-tenant sessions)
+    silently overwrote each other and ``/statusz`` showed whichever
+    registered second.  Engines now register here instead; the combined
+    ``engine`` provider renders ONE engine as the same flat snapshot as
+    before (plus a ``session`` key) and several as
+    ``{"mode": "multi", "sessions": {session: snapshot}}``.
+    """
+    with _lock:
+        _engines[str(session)] = fn
+        _providers["engine"] = _engine_status
+
+
+def unregister_engine_status(session: str, fn: Optional[Callable[[], Any]] = None) -> None:
+    """Remove one engine's snapshot (identity-checked like
+    :func:`unregister_status_provider`); the combined provider goes with
+    the last engine."""
+    with _lock:
+        if fn is None or _engines.get(str(session)) is fn:
+            _engines.pop(str(session), None)
+        if not _engines and _providers.get("engine") is _engine_status:
+            _providers.pop("engine", None)
+
+
+def _engine_status() -> Any:
+    """The combined ``engine`` /statusz block over every registered
+    engine.  A snapshot callable that raises contributes its error string,
+    same contract as :func:`status_snapshot`."""
+    with _lock:
+        engines = dict(_engines)
+
+    def _snap(fn: Callable[[], Any]) -> Any:
+        try:
+            return fn()
+        except Exception as e:  # pragma: no cover - defensive
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    if len(engines) == 1:
+        (sid, fn), = engines.items()
+        snap = _snap(fn)
+        if isinstance(snap, dict):
+            snap.setdefault("session", sid)
+        return snap
+    return {"mode": "multi",
+            "sessions": {sid: _snap(fn) for sid, fn in sorted(engines.items())}}
 
 
 def status_snapshot() -> Dict[str, Any]:
@@ -245,13 +303,20 @@ class StallWatchdog:
         self.on_straggler = on_straggler
         self._lock = threading.Lock()
         self._rtts: deque = deque(maxlen=int(window))
-        self._inflight: Dict[str, Tuple[float, str]] = {}  # job_id -> (t0, worker)
+        # job_id -> (t0, worker, session | None)
+        self._inflight: Dict[str, Tuple[float, str, Optional[str]]] = {}
         self._flagged: Dict[str, Dict[str, Any]] = {}
         self.detected_total = 0
 
-    def job_started(self, job_id: str, worker_id: str) -> None:
+    def job_started(self, job_id: str, worker_id: str,
+                    session: Optional[str] = None) -> None:
+        """``session`` (multi-tenant brokers) rides into the straggler
+        info/event and labels ``stragglers_detected_total``; ``None`` (the
+        single-tenant default) keeps the metric series unchanged."""
         with self._lock:
-            self._inflight[str(job_id)] = (time.monotonic(), str(worker_id))
+            self._inflight[str(job_id)] = (
+                time.monotonic(), str(worker_id),
+                None if session is None else str(session))
 
     def job_finished(self, job_id: str) -> None:
         """Result accepted: record the RTT sample and clear any flag."""
@@ -290,7 +355,7 @@ class StallWatchdog:
         newly: List[Dict[str, Any]] = []
         with self._lock:
             thr = self._threshold_locked()
-            for job_id, (t0, worker_id) in self._inflight.items():
+            for job_id, (t0, worker_id, session) in self._inflight.items():
                 age = t - t0
                 if age > thr and job_id not in self._flagged:
                     info = {
@@ -299,12 +364,16 @@ class StallWatchdog:
                         "age_s": round(age, 3),
                         "threshold_s": round(thr, 3),
                     }
+                    if session is not None:
+                        info["session"] = session
                     self._flagged[job_id] = info
                     self.detected_total += 1
                     newly.append(info)
         for info in newly:
-            get_registry().counter(
-                "stragglers_detected_total", worker=info["worker_id"]).inc()
+            labels = {"worker": info["worker_id"]}
+            if "session" in info:
+                labels["session"] = info["session"]
+            get_registry().counter("stragglers_detected_total", **labels).inc()
             _spans.record_event("straggler_detected", dict(info))
             if self.on_straggler is not None:
                 try:

@@ -38,10 +38,12 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
 from typing import Any, Dict, Optional
 
-__all__ = ["Checkpointer", "load_checkpoint", "CHECKPOINT_SCHEMA"]
+__all__ = ["Checkpointer", "load_checkpoint", "namespaced_path",
+           "CHECKPOINT_SCHEMA"]
 
 #: Newest checkpoint layout this code can write and read (see the module
 #: docstring for the version history).
@@ -63,6 +65,22 @@ def _to_jsonable(obj: Any) -> Any:
     return obj
 
 
+def namespaced_path(path: str, namespace: Optional[str]) -> str:
+    """Insert a per-session namespace into a checkpoint path.
+
+    ``search.json`` + namespace ``tenant-a`` → ``search.tenant-a.json``,
+    so concurrent searches sharing one fleet (DISTRIBUTED.md "Multi-tenant
+    search sessions") never clobber each other's checkpoints.  The
+    namespace is sanitized to filename-safe characters; ``None``/empty
+    returns the path unchanged.
+    """
+    if not namespace:
+        return str(path)
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", str(namespace))
+    root, ext = os.path.splitext(str(path))
+    return f"{root}.{safe}{ext}" if ext else f"{root}.{safe}"
+
+
 class Checkpointer:
     """Atomic JSON checkpoints, attached to a GA via ``set_checkpointer``.
 
@@ -72,8 +90,10 @@ class Checkpointer:
     previous checkpoint intact.
     """
 
-    def __init__(self, path: str, keep_history: bool = True):
-        self.path = str(path)
+    def __init__(self, path: str, keep_history: bool = True,
+                 namespace: Optional[str] = None):
+        self.path = namespaced_path(path, namespace)
+        self.namespace = str(namespace) if namespace else None
         self.keep_history = keep_history
 
     def save(self, algorithm) -> None:

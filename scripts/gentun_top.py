@@ -41,6 +41,8 @@ _HEADLINE_COUNTERS = (
     "fitness_service_misses_total",
     "fitness_service_evictions_total",
     "worker_drains_total",
+    "session_rejected_total",
+    "session_quarantined_total",
 )
 
 
@@ -110,15 +112,23 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
 
     eng = statusz.get("engine")
     if eng:
-        if eng.get("mode") == "async":
-            prog = (f"completed {eng.get('completed')}/{eng.get('dispatched')} "
-                    f"in-flight {eng.get('in_flight')} queued {eng.get('queued')}")
-        else:
-            prog = (f"generation {eng.get('generation')} "
-                    f"pop {eng.get('population_size')}")
-        lines.append(f"{B}engine{X} [{eng.get('mode', '?')}]  {prog}  "
-                     f"best {eng.get('best_fitness')}  "
-                     f"{D}trace {eng.get('trace_id')}{X}")
+        # With several searches on one broker the "engine" block is a
+        # {"mode": "multi", "sessions": {...}} map — one line per tenant.
+        engines = (eng.get("sessions", {}) if eng.get("mode") == "multi"
+                   else {eng.get("session", "default"): eng})
+        for sid, e in engines.items():
+            if not isinstance(e, dict):
+                lines.append(f"{B}engine{X} [{sid}]  {R}{e}{X}")
+                continue
+            if e.get("mode") == "async":
+                prog = (f"completed {e.get('completed')}/{e.get('dispatched')} "
+                        f"in-flight {e.get('in_flight')} queued {e.get('queued')}")
+            else:
+                prog = (f"generation {e.get('generation')} "
+                        f"pop {e.get('population_size')}")
+            lines.append(f"{B}engine{X} [{e.get('mode', '?')}:{sid}]  {prog}  "
+                         f"best {e.get('best_fitness')}  "
+                         f"{D}trace {e.get('trace_id')}{X}")
 
     fleet = statusz.get("fleet")
     if fleet:
@@ -156,6 +166,25 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
         for s in fleet.get("stragglers", []):
             lines.append(f"  {Y}~ straggler {s['job_id']} on {s['worker_id']} "
                          f"({s['age_s']}s > {s['threshold_s']}s){X}")
+        sessions = fleet.get("sessions")
+        if sessions:
+            # Per-tenant panel (multi-tenant sessions): who is getting the
+            # fleet, who is throttled by quota, who is quarantining genomes.
+            lines.append(f"  {D}{'session':<16}{'wt':>5}{'done':>7}{'fly':>5}"
+                         f"{'queue':>7}{'quota':>7}{'quar':>6}{'rej':>5}{X}")
+            for sid in sorted(sessions):
+                s = sessions[sid]
+                quota = s.get("max_in_flight")
+                lines.append(
+                    f"  {str(sid)[:16]:<16}"
+                    f"{s.get('weight', 1):>5g}"
+                    f"{s.get('completed', 0):>7}"
+                    f"{s.get('in_flight', 0):>5}"
+                    f"{s.get('queued', 0):>7}"
+                    f"{quota if quota is not None else '-':>7}"
+                    f"{s.get('quarantined', 0):>6}"
+                    f"{s.get('rejected', 0):>5}"
+                    + (f"  {Y}CLOSED{X}" if s.get("closed") else ""))
 
     worker = statusz.get("worker")
     if worker:

@@ -199,7 +199,7 @@ class TestBrokerBasics:
             assert pop.broker._results == {}
             assert pop.broker._failures == {}
             assert pop.broker._payloads == {}
-            assert len(pop.broker._pending) == 0  # cancelled ids drained too
+            assert pop.broker._sched.depth() == 0  # cancelled ids drained too
 
     def test_non_ascii_password_accepted(self):
         """hmac token compare must handle non-ASCII secrets (UTF-8 bytes)."""

@@ -193,6 +193,78 @@ class TestStaleFleetSizing:
             pop.close()
 
 
+class TestSessionCreditAccounting:
+    def test_drain_conserves_credits_across_concurrent_sessions(self):
+        """Two sessions over-subscribe one prefetching worker; the worker
+        drains mid-first-job.  The broker must requeue exactly the
+        drained worker's unstarted jobs — each back onto ITS OWN
+        session's queue — and the credit books must balance so a
+        replacement worker finishes everything with zero leaked state."""
+        from gentun_tpu.distributed import JobBroker
+
+        class Slow(OneMax):
+            def evaluate(self):
+                time.sleep(0.6)
+                return super().evaluate()
+
+        genomes = [ind.get_genes() for ind in
+                   Population(OneMax, DATA, size=6, seed=13, maximize=True)]
+        expected = {
+            f"{s}{i}": float(sum(sum(g) for g in genomes[k].values()))
+            for k, (s, i) in enumerate((s, i) for s in "ab" for i in range(3))
+        }
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            sa = broker.open_session("cred-a")
+            sb = broker.open_session("cred-b")
+            # One worker, window 1 + 4: both sessions' backlogs land in its
+            # local prefetch queue (over-subscription).
+            c0, s0, _ = _spawn_worker(Slow, port, "cr-w0", capacity=1,
+                                      prefetch_depth=4)
+            assert _wait(lambda: broker.fleet_members() == 1)
+            broker.submit({f"a{i}": {"genes": genomes[i]} for i in range(3)},
+                          session=sa)
+            broker.submit({f"b{i}": {"genes": genomes[3 + i]} for i in range(3)},
+                          session=sb)
+            # Window 5 of 6 dispatched; the sixth waits at the broker.
+            assert _wait(lambda: broker._ops_status()["jobs_in_flight"] == 5)
+            c0.drain()  # lands at the a0 batch boundary
+            stats = lambda: broker.session_stats()
+            # The worker finishes a0 (results + ready restore one credit,
+            # which hands it the queued b2 just before the drain frame is
+            # processed), then returns every unstarted job: a1,a2 back to
+            # session A, b0,b1 via the drain requeue and b2 via the
+            # disconnect path — 5 total, each onto ITS OWN session queue.
+            assert _wait(lambda: stats()[sa]["requeued"]
+                         + stats()[sb]["requeued"] == 5, timeout=15)
+            assert stats()[sa]["requeued"] == 2
+            assert stats()[sb]["requeued"] == 3
+            assert stats()[sa]["completed"] == 1
+            assert _wait(lambda: broker.outstanding()["pending"] == 5)
+            s0.set()
+            # A replacement worker drains the conserved backlog dry.
+            c1, s1, _ = _spawn_worker(Slow, port, "cr-w1", capacity=1,
+                                      prefetch_depth=4)
+            results = broker.gather(list(expected), timeout=60)
+            assert results == expected
+            final = stats()
+            assert final[sa]["completed"] == 3 and final[sb]["completed"] == 3
+            assert final[sa]["submitted"] == 3 and final[sb]["submitted"] == 3
+            assert final[sa]["rejected"] == 0 and final[sb]["rejected"] == 0
+            # Credit conservation: every ack restored a credit, so the
+            # replacement's window refills completely, and no job-state
+            # table leaks an entry.
+            assert _wait(lambda: all(
+                w["credit"] == w["capacity"] + w["prefetch_depth"]
+                for w in broker._ops_status()["workers"]), timeout=15)
+            assert all(v == 0 for v in broker.outstanding().values()), \
+                broker.outstanding()
+            s1.set()
+        finally:
+            broker.stop()
+
+
 @pytest.mark.slow
 class TestElasticEndToEnd:
     def test_drain_plus_late_join_matches_fixed_fleet(self):

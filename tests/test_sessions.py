@@ -1,0 +1,567 @@
+"""Multi-tenant search sessions: registry, fair-share scheduler, wire
+clients, quarantine isolation, and per-session observability.
+
+One broker, many concurrent searches (ISSUE 8): old single-tenant masters
+ride an implicit default session unchanged; explicit tenants get weighted
+deficit-round-robin dispatch shares, in-flight quotas, per-session
+poison-genome quarantine, and loud structured rejection of mis-addressed
+jobs (never a silent drop).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import (
+    DEFAULT_SESSION,
+    DistributedPopulation,
+    FairShareScheduler,
+    GentunClient,
+    JobBroker,
+    SessionClient,
+    UnknownSessionError,
+    genome_key,
+)
+from gentun_tpu.distributed.faults import FaultInjector, FaultPlan, FaultSpec
+from gentun_tpu.distributed.fitness_service import ServiceBackedCache, wire_key
+from gentun_tpu.distributed.sessions import SessionRegistry
+from gentun_tpu.telemetry import health as _health
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+from gentun_tpu.utils.checkpoint import Checkpointer, namespaced_path
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+class PoisonousOneMax(OneMax):
+    """Fails evaluation when the job carries a ``poison`` parameter —
+    lets a test make ONE genome toxic for one tenant's species while the
+    same genes stay evaluable for everyone else."""
+
+    def evaluate(self):
+        if self.additional_parameters.get("poison"):
+            raise ValueError("poison genome")
+        return super().evaluate()
+
+
+class SlowOneMax(OneMax):
+    def evaluate(self):
+        time.sleep(0.15)
+        return super().evaluate()
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    _health.disable()
+    _health.reset()
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    _health.disable()
+    _health.reset()
+    get_registry().reset()
+
+
+def _spawn_worker(species, port, worker_id, capacity=1, prefetch_depth=None,
+                  fault_injector=None):
+    stop = threading.Event()
+    client = GentunClient(
+        species, *DATA, host="127.0.0.1", port=port, capacity=capacity,
+        prefetch_depth=prefetch_depth, worker_id=worker_id,
+        heartbeat_interval=0.2, reconnect_delay=0.05,
+        fault_injector=fault_injector,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return client, stop, t
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _genomes(n, seed=0):
+    """n valid OneMax genomes (deterministic)."""
+    pop = Population(OneMax, DATA, size=n, seed=seed, maximize=True)
+    return [ind.get_genes() for ind in pop]
+
+
+def _counter_total(name):
+    snap = get_registry().snapshot()
+    return sum(c["value"] for c in snap["counters"] if c["name"] == name)
+
+
+# ---------------------------------------------------------------------------
+# Pure units: genome_key, registry, scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestGenomeKey:
+    def test_stable_and_order_insensitive(self):
+        a = {"x": [1, 2], "y": 3}
+        b = {"y": 3, "x": [1, 2]}
+        assert genome_key(a) == genome_key(b)
+        assert genome_key(a) != genome_key({"x": [1, 2], "y": 4})
+
+    def test_unjsonable_genes_fall_back_to_repr(self):
+        k = genome_key({"f": object})  # classes don't survive json
+        assert isinstance(k, str) and len(k) == 16
+
+
+class TestSessionRegistry:
+    def test_open_is_idempotent_and_updates_priority(self):
+        reg = SessionRegistry()
+        s1 = reg.open("a", weight=1.0)
+        s2 = reg.open("a", weight=3.0, max_in_flight=2)
+        assert s1 is s2
+        assert s1.weight == 3.0 and s1.max_in_flight == 2
+
+    def test_reopening_a_closed_session_raises(self):
+        reg = SessionRegistry()
+        reg.open("a")
+        reg.close("a")
+        with pytest.raises(UnknownSessionError):
+            reg.open("a")
+
+    def test_default_session_is_lazy(self):
+        reg = SessionRegistry()
+        assert reg.peek(DEFAULT_SESSION) is None
+        reg.ensure_default()
+        assert reg.peek(DEFAULT_SESSION) is not None
+
+    def test_minted_ids_are_unique(self):
+        reg = SessionRegistry()
+        assert reg.open().session_id != reg.open().session_id
+
+
+class TestFairShareScheduler:
+    @staticmethod
+    def _sched(weights):
+        return FairShareScheduler(lambda sid: weights.get(sid, 1.0))
+
+    @staticmethod
+    def _drain(sched, eligible=lambda s: True, valid=lambda j: True, n=10 ** 6):
+        out = []
+        for _ in range(n):
+            nxt = sched.pop_next(eligible, valid)
+            if nxt is None:
+                break
+            out.append(nxt)
+        return out
+
+    def test_single_session_is_fifo(self):
+        sched = self._sched({})
+        for j in ("j1", "j2", "j3"):
+            sched.push("solo", j)
+        assert [j for _, j in self._drain(sched)] == ["j1", "j2", "j3"]
+
+    def test_equal_weights_interleave(self):
+        sched = self._sched({"a": 1.0, "b": 1.0})
+        for i in range(4):
+            sched.push("a", f"a{i}")
+            sched.push("b", f"b{i}")
+        sids = [s for s, _ in self._drain(sched)]
+        # Served round-robin, not one tenant drained at a time.
+        assert sids[:4].count("a") == 2 and sids[:4].count("b") == 2
+
+    def test_two_to_one_weights_give_two_to_one_share(self):
+        sched = self._sched({"gold": 2.0, "bronze": 1.0})
+        for i in range(8):
+            sched.push("gold", f"g{i}")
+        for i in range(4):
+            sched.push("bronze", f"b{i}")
+        sids = [s for s, _ in self._drain(sched)]
+        # While both are backlogged (first 6 pops) gold gets 2× bronze.
+        assert sids[:6].count("gold") == 4
+        assert sids[:6].count("bronze") == 2
+        assert len(sids) == 12  # nothing lost
+
+    def test_idle_session_forfeits_deficit(self):
+        # b drains; a (weight 1) must then receive EVERY slot — b cannot
+        # bank priority while idle (work conservation).
+        sched = self._sched({"a": 1.0, "b": 5.0})
+        for i in range(6):
+            sched.push("a", f"a{i}")
+        sched.push("b", "b0")
+        sids = [s for s, _ in self._drain(sched)]
+        assert sids.count("a") == 6 and sids.count("b") == 1
+        # b re-arrives later with no carried-over burst credit.
+        for i in range(3):
+            sched.push("a", f"x{i}")
+            sched.push("b", f"y{i}")
+        burst = [s for s, _ in self._drain(sched, n=2)]
+        assert burst.count("b") <= 2
+
+    def test_quota_ineligible_session_passes_its_turn(self):
+        sched = self._sched({"a": 1.0, "b": 1.0})
+        sched.push("a", "a0")
+        sched.push("b", "b0")
+        assert sched.pop_next(lambda s: s != "a", lambda j: True) == ("b", "b0")
+        # Everyone quota-full → None, and the jobs stay queued.
+        assert sched.pop_next(lambda s: False, lambda j: True) is None
+        assert sched.session_depth("a") == 1
+
+    def test_cancelled_jobs_cost_no_deficit(self):
+        sched = self._sched({"a": 1.0})
+        sched.push("a", "dead")
+        sched.push("a", "live")
+        assert sched.pop_next(lambda s: True, lambda j: j != "dead") == ("a", "live")
+        assert sched.depth() == 0
+
+    def test_remove_and_clear(self):
+        sched = self._sched({})
+        for j in ("a0", "a1"):
+            sched.push("a", j)
+        sched.push("b", "b0")
+        sched.remove({"a0"})
+        assert sched.session_depth("a") == 1 and sched.queued("a1")
+        assert sched.clear_session("a") == ["a1"]
+        assert sched.depth() == 1  # only b0 left
+
+
+# ---------------------------------------------------------------------------
+# Broker integration: rejection, capacity shares, quarantine
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerSessions:
+    def test_unknown_session_submit_is_loud(self):
+        broker = JobBroker(port=0).start()
+        try:
+            with pytest.raises(UnknownSessionError):
+                broker.submit({"j1": {"genes": {}}}, session="ghost")
+            assert _counter_total("session_rejected_total") == 1
+        finally:
+            broker.stop()
+
+    def test_closed_session_submit_is_loud(self):
+        broker = JobBroker(port=0).start()
+        try:
+            sid = broker.open_session("t1")
+            broker.close_session(sid)
+            with pytest.raises(UnknownSessionError):
+                broker.submit({"j1": {"genes": {}}}, session=sid)
+            assert broker.session_stats()[sid]["rejected"] == 1
+            assert _counter_total("session_rejected_total") == 1
+        finally:
+            broker.stop()
+
+    def test_capacity_shares_follow_weights_and_quotas(self):
+        broker = JobBroker(port=0)
+        broker.fleet_capacity = lambda: 6  # no live fleet needed
+        broker.fleet_prefetch = lambda: 3
+        # Unknown session / no sessions: old single-tenant full-fleet reads.
+        assert broker.session_capacity() == 6
+        assert broker.session_capacity("nobody") == 6
+        a = broker.open_session("a", weight=2.0)
+        assert broker.session_capacity(a) == 6  # sole tenant
+        b = broker.open_session("b", weight=1.0)
+        assert broker.session_capacity(a) == 4
+        assert broker.session_capacity(b) == 2
+        assert broker.session_prefetch(a) == 2
+        assert broker.session_prefetch(b) == 1
+        # Quota clamps share; light tenants always make progress (min 1).
+        broker.open_session("b", weight=1.0, max_in_flight=1)
+        assert broker.session_capacity(b) == 1
+        broker.close_session(b)
+        assert broker.session_capacity(a) == 6  # share flows back
+
+    def test_quarantine_isolates_poison_genome_per_session(self):
+        genes = _genomes(1, seed=3)[0]
+        broker = JobBroker(port=0, max_attempts=1, quarantine_after=1).start()
+        try:
+            _, port = broker.address
+            _, stop, _ = _spawn_worker(PoisonousOneMax, port, "q-w0")
+            sa = broker.open_session("tenant-a")
+            sb = broker.open_session("tenant-b")
+            broker.submit(
+                {"pa": {"genes": genes, "additional_parameters": {"poison": True}}},
+                session=sa)
+            _, fails = broker.wait_any(["pa"], timeout=15)
+            assert "pa" in fails
+            stats = broker.session_stats()
+            assert stats[sa]["failed"] == 1 and stats[sa]["quarantined"] == 1
+            assert _counter_total("session_quarantined_total") == 1
+            # Same genes again in A: instant terminal failure, never
+            # dispatched (submitted counter does not move).
+            broker.submit({"pa2": {"genes": genes}}, session=sa)
+            _, fails = broker.wait_any(["pa2"], timeout=10)
+            assert "quarantined" in fails["pa2"]
+            stats = broker.session_stats()
+            assert stats[sa]["submitted"] == 1 and stats[sa]["rejected"] == 1
+            # The NEIGHBOR session evaluates the identical genome fine.
+            broker.submit({"pb": {"genes": genes}}, session=sb)
+            results, fails = broker.wait_any(["pb"], timeout=15)
+            assert fails == {}
+            assert results["pb"] == float(sum(sum(g) for g in genes.values()))
+            assert broker.session_stats()[sb]["quarantined"] == 0
+            stop.set()
+        finally:
+            broker.stop()
+
+    def test_crash_quarantine_caps_disconnect_redelivery(self):
+        """A genome that CRASHES its worker (drop mid-results, twice) is
+        failed terminally and quarantined once ``quarantine_crash_requeues``
+        redeliveries burn — instead of crash-looping the fleet forever."""
+        genes = _genomes(1, seed=4)[0]
+        inj = FaultInjector(FaultPlan([
+            FaultSpec(hook="client_send", kind="drop_connection",
+                      match_type="results", at=0, times=2),
+        ]))
+        # Short heartbeat timeout: the injected drop leaves the client's
+        # blocked reader holding the old socket open, so the broker learns
+        # of the crash from the reaper, not an EOF.
+        broker = JobBroker(port=0, quarantine_crash_requeues=2,
+                           heartbeat_timeout=1.0).start()
+        try:
+            _, port = broker.address
+            _, stop, _ = _spawn_worker(OneMax, port, "c-w0",
+                                        fault_injector=inj)
+            sid = broker.open_session("crashy")
+            broker.submit({"cj": {"genes": genes}}, session=sid)
+            _, fails = broker.wait_any(["cj"], timeout=30)
+            assert "crashed" in fails["cj"]
+            stats = broker.session_stats()[sid]
+            assert stats["quarantined"] == 1
+            assert len([f for f in inj.fired
+                        if f["kind"] == "drop_connection"]) == 2
+            # Books balanced: no payload/session/crash state leaks.
+            assert _wait(lambda: all(
+                v == 0 for v in broker.outstanding().values())), \
+                broker.outstanding()
+            stop.set()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Wire tenants: SessionClient round trip, loud rejection, detach parking
+# ---------------------------------------------------------------------------
+
+
+class TestSessionClientWire:
+    def test_round_trip_and_unknown_session_error_frame(self):
+        genes = _genomes(1, seed=5)[0]
+        broker = JobBroker(port=0).start()
+        sc = None
+        try:
+            _, port = broker.address
+            _, stop, _ = _spawn_worker(OneMax, port, "w-w0")
+            sc = SessionClient("127.0.0.1", port)
+            sid = sc.open_session("wire-a", weight=2.0)
+            assert sid == "wire-a"
+            jobs = sc.submit(sid, {"wj": {"genes": genes}})
+            results, fails = sc.wait_any(jobs, timeout=15)
+            assert fails == {}
+            assert results["wj"] == float(sum(sum(g) for g in genes.values()))
+            # Mis-addressed submit: a structured error frame, not silence.
+            sc.submit("never-opened", {"xj": {"genes": genes}})
+            assert _wait(lambda: sc.last_error() is not None)
+            err = sc.last_error()
+            assert err["code"] == "session" and err["session"] == "never-opened"
+            assert _counter_total("session_rejected_total") == 1
+            # Closing over the wire: later submits are rejected too.
+            sc.close_session(sid)
+            sc.submit(sid, {"yj": {"genes": genes}})
+            assert _wait(
+                lambda: (sc.last_error() or {}).get("session") == "wire-a")
+            stop.set()
+        finally:
+            if sc is not None:
+                sc.close()
+            broker.stop()
+
+    def test_detach_parks_results_until_reattach(self):
+        genes = _genomes(1, seed=6)[0]
+        broker = JobBroker(port=0).start()
+        sc = None
+        try:
+            _, port = broker.address
+            _, stop, _ = _spawn_worker(SlowOneMax, port, "d-w0")
+            sc = SessionClient("127.0.0.1", port)
+            sid = sc.open_session("parky")
+            jobs = sc.submit(sid, {"dj": {"genes": genes}})
+            sc.detach(sid)  # before the 0.15 s evaluation lands
+            sess = broker._registry.peek(sid)
+            assert _wait(lambda: len(sess.undelivered) == 1, timeout=15)
+            sc.open_session(sid)  # re-attach flushes the parked frame
+            results, fails = sc.wait_any(jobs, timeout=15)
+            assert fails == {} and results["dj"] > 0
+            assert len(sess.undelivered) == 0
+            stop.set()
+        finally:
+            if sc is not None:
+                sc.close()
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability: per-session /statusz engine rows, session labels
+# ---------------------------------------------------------------------------
+
+
+class TestEngineStatusRegistry:
+    def test_single_engine_renders_flat_with_session(self):
+        _health.register_engine_status("solo", lambda: {"mode": "async", "completed": 3})
+        snap = _health.status_snapshot()["engine"]
+        assert snap["mode"] == "async" and snap["session"] == "solo"
+
+    def test_two_engines_render_per_session_not_last_wins(self):
+        fn_a = lambda: {"mode": "generational", "generation": 1}
+        fn_b = lambda: {"mode": "async", "completed": 9}
+        _health.register_engine_status("a", fn_a)
+        _health.register_engine_status("b", fn_b)
+        snap = _health.status_snapshot()["engine"]
+        assert snap["mode"] == "multi"
+        assert snap["sessions"]["a"]["generation"] == 1
+        assert snap["sessions"]["b"]["completed"] == 9
+        # Engines unwind independently; the combined provider goes with
+        # the last one.
+        _health.unregister_engine_status("a", fn_a)
+        snap = _health.status_snapshot()["engine"]
+        assert snap["completed"] == 9 and snap["session"] == "b"
+        _health.unregister_engine_status("b", fn_b)
+        assert "engine" not in _health.status_snapshot()
+
+    def test_unregister_is_identity_checked(self):
+        fn_old = lambda: {"mode": "async"}
+        fn_new = lambda: {"mode": "generational"}
+        _health.register_engine_status("s", fn_old)
+        _health.register_engine_status("s", fn_new)
+        _health.unregister_engine_status("s", fn_old)  # stale: must not evict
+        assert _health.status_snapshot()["engine"]["mode"] == "generational"
+
+    def test_statusz_sessions_block_and_flow_gauges(self):
+        spans_mod.enable()
+        broker = JobBroker(port=0).start()
+        try:
+            sid = broker.open_session("viz", weight=2.0)
+            broker.submit({"vj": {"genes": {"g": [1]}}}, session=sid)
+            assert _wait(lambda: broker._ops_status()["sessions"]
+                         .get(sid, {}).get("queued") == 1)
+            snap = get_registry().snapshot()
+            depth = {tuple(sorted(g["labels"].items())): g["value"]
+                     for g in snap["gauges"]
+                     if g["name"] == "session_queue_depth"}
+            assert depth[(("session", "viz"),)] == 1
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Per-session namespaces: checkpoints and the shared fitness cache
+# ---------------------------------------------------------------------------
+
+
+class TestSessionNamespaces:
+    def test_namespaced_path(self):
+        assert namespaced_path("run/ck.json", None) == "run/ck.json"
+        assert namespaced_path("run/ck.json", "tenant-a") == "run/ck.tenant-a.json"
+        assert namespaced_path("ck", "a/b") == "ck.a_b"  # sanitized
+
+    def test_checkpointer_namespace_separates_tenants(self, tmp_path):
+        class Stub:
+            def state_dict(self):
+                return {"history": [1]}
+
+        base = str(tmp_path / "search.json")
+        Checkpointer(base, namespace="t1").save(Stub())
+        Checkpointer(base, namespace="t2").save(Stub())
+        assert (tmp_path / "search.t1.json").exists()
+        assert (tmp_path / "search.t2.json").exists()
+        assert not (tmp_path / "search.json").exists()
+        assert Checkpointer(base, namespace="t1").load() is not None
+
+    def test_cache_namespace_prefixes_wire_keys(self):
+        key = ("OneMax", (("a", 1),), ())
+        shared = ServiceBackedCache(None)
+        scoped = ServiceBackedCache(None, namespace="t1")
+        # Default: content-addressed keys, identical across tenants
+        # (cross-tenant dedup stays ON).
+        assert shared._wire_key(key) == wire_key(key)
+        assert scoped._wire_key(key) == f"t1/{wire_key(key)}"
+
+
+# ---------------------------------------------------------------------------
+# Two tenants, one fleet, unmodified engines
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrentSearches:
+    def test_two_generational_tenants_match_their_solo_runs(self):
+        """Two seeded GA searches share one broker + fleet via sessions;
+        each must finish bit-identical to its solo reference (fitness is a
+        pure function of genes, so fair-share timing cannot steer them)."""
+        generations, size = 2, 4
+        refs = [
+            GeneticAlgorithm(
+                Population(OneMax, DATA, size=size, seed=20 + i, maximize=True),
+                seed=40 + i).run(generations)
+            for i in range(2)
+        ]
+
+        owner = DistributedPopulation(OneMax, size=size, seed=20, port=0,
+                                      maximize=True, job_timeout=60,
+                                      session="tenant0", session_weight=2.0)
+        tenants = [owner]
+        workers = []
+        try:
+            _, port = owner.broker_address
+            tenants.append(DistributedPopulation(
+                OneMax, size=size, seed=21, maximize=True, job_timeout=60,
+                broker=owner.broker, session="tenant1"))
+            for i in range(2):
+                workers.append(_spawn_worker(OneMax, port, f"cc-w{i}"))
+            assert _wait(lambda: owner.broker.fleet_members() == 2)
+            # Each tenant's dispatch window is its weighted SHARE.
+            assert owner.fleet_capacity() + tenants[1].fleet_capacity() <= 4
+            assert owner.fleet_capacity() >= tenants[1].fleet_capacity()
+
+            bests, errors = [None, None], []
+
+            def _run(i, pop):
+                try:
+                    bests[i] = GeneticAlgorithm(pop, seed=40 + i).run(generations)
+                except Exception as e:  # pragma: no cover - surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=_run, args=(i, p), daemon=True)
+                       for i, p in enumerate(tenants)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            for best, ref in zip(bests, refs):
+                assert best.get_fitness() == ref.get_fitness()
+                assert best.get_genes() == ref.get_genes()
+            stats = owner.broker.session_stats()
+            assert stats["tenant0"]["completed"] > 0
+            assert stats["tenant1"]["completed"] > 0
+            assert DEFAULT_SESSION not in stats  # nobody rode the default
+            for _, stop, _t in workers:
+                stop.set()
+        finally:
+            for p in tenants[1:]:
+                p.close()
+            owner.close()
