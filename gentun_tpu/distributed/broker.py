@@ -105,11 +105,12 @@ class _Worker:
     """Per-connection state, touched only from the broker loop thread."""
 
     __slots__ = ("worker_id", "writer", "capacity", "prefetch_depth", "credit",
-                 "in_flight", "last_seen", "n_chips", "backend", "draining")
+                 "in_flight", "last_seen", "n_chips", "backend", "draining",
+                 "mesh")
 
     def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
                  n_chips: int = 1, backend: Optional[str] = None,
-                 prefetch_depth: int = 0):
+                 prefetch_depth: int = 0, mesh: Optional[Dict[str, int]] = None):
         self.worker_id = worker_id
         self.writer = writer
         self.capacity = capacity
@@ -122,6 +123,11 @@ class _Worker:
         self.last_seen = time.monotonic()
         self.n_chips = n_chips
         self.backend = backend
+        #: host-mesh advertisement (protocol.py "Host-mesh field"):
+        #: {"pop": P, "data": D, "devices": N} for a host-level mesh
+        #: worker whose capacity derives from its device mesh; None for
+        #: per-chip workers (the entire pre-mesh fleet).
+        self.mesh = mesh
         #: True once the worker announced an orderly exit (elastic
         #: membership): no new dispatches, excluded from the fleet sums —
         #: but still a live connection until its in-flight results land.
@@ -792,6 +798,24 @@ class JobBroker:
         read — safe from any thread."""
         return len(self._workers)
 
+    def fleet_mesh_pop(self) -> int:
+        """Largest pop-axis size advertised by the LIVE fleet (1 when no
+        worker advertised a mesh).
+
+        The master-side half of mesh-aware dispatch: a host-level mesh
+        worker pads every evaluation window up to its pop-axis multiple,
+        so batch sizing that rounds to this multiple (speculative fill,
+        ``DistributedPopulation._fill_target``) turns would-be padding
+        waste into paid-for work.  Max — not LCM — across a heterogeneous
+        fleet: aligning to the widest mesh keeps the biggest worker
+        waste-free and costs the narrow ones nothing (their multiple
+        divides the bucket shapes anyway on power-of-two hosts).
+        Snapshot read — safe from any thread.
+        """
+        pops = [int((w.mesh or {}).get("pop", 1))
+                for w in list(self._workers.values()) if not w.draining]
+        return max([1] + [p for p in pops if p > 0])
+
     def fleet_chips(self) -> int:
         """Total accelerator chips advertised by the connected workers (≥1).
 
@@ -857,6 +881,28 @@ class JobBroker:
         except (TypeError, ValueError):
             return 0
         return max(0, min(depth, 4 * capacity))
+
+    @staticmethod
+    def _parse_mesh(msg: Dict[str, Any]) -> Optional[Dict[str, int]]:
+        """The worker's OPTIONAL host-mesh advertisement, validated.
+
+        Expects ``{"pop": P, "data": D, "devices": N}`` with positive
+        ints (``devices`` may be 0 = unknown).  Advisory observability
+        data — malformed values degrade to None (no mesh recorded), never
+        drop the worker, same convention as ``n_chips``.
+        """
+        mesh = msg.get("mesh")
+        if not isinstance(mesh, dict):
+            return None
+        try:
+            pop = int(mesh.get("pop", 1))
+            data = int(mesh.get("data", 1))
+            devices = int(mesh.get("devices", 0))
+        except (TypeError, ValueError):
+            return None
+        if pop < 1 or data < 1 or devices < 0:
+            return None
+        return {"pop": pop, "data": data, "devices": devices}
 
     # -- loop-thread internals --------------------------------------------
 
@@ -1161,6 +1207,7 @@ class JobBroker:
             "n_chips": w.n_chips,
             "backend": w.backend,
             "draining": w.draining,
+            "mesh": w.mesh,
         } for w in list(self._workers.values())]
         return {
             "address": list(self._bound) if self._started.is_set() else None,
@@ -1175,6 +1222,9 @@ class JobBroker:
             "straggler_threshold_s": round(self._watchdog.threshold(), 3),
             "stragglers": self._watchdog.stragglers(),
             "straggler_requeue": self._straggler_requeue,
+            # Widest advertised pop axis (1 = no mesh workers): the
+            # multiple mesh-aware batch sizing aligns to.
+            "mesh_pop_multiple": self.fleet_mesh_pop(),
             # Tenant table (empty until the first submit/open_session):
             # per-session books for the /statusz sessions panel.
             "sessions": self.session_stats(),
@@ -1219,6 +1269,7 @@ class JobBroker:
                 n_chips=n_chips,
                 backend=str(backend) if backend is not None else None,
                 prefetch_depth=self._parse_prefetch(hello, capacity),
+                mesh=self._parse_mesh(hello),
             )
             # Heterogeneous-fleet check (ADVICE r3): two workers scoring one
             # generation with different estimators (e.g. xgb.cv on one host,
@@ -1244,9 +1295,11 @@ class JobBroker:
             })
             writer.write(encode({"type": "welcome"}))
             logger.info(
-                "worker %s connected (capacity %d, prefetch %d, %d chip(s))",
+                "worker %s connected (capacity %d, prefetch %d, %d chip(s)%s)",
                 worker.worker_id, worker.capacity, worker.prefetch_depth,
                 worker.n_chips,
+                ", mesh pop=%(pop)d x data=%(data)d" % worker.mesh
+                if worker.mesh else "",
             )
 
             while True:
@@ -1590,11 +1643,17 @@ class JobBroker:
                 pass
         if "prefetch_depth" in msg:
             w.prefetch_depth = self._parse_prefetch(msg, w.capacity)
+        if "mesh" in msg:
+            # Host-mesh workers re-advertise their shape with the new
+            # capacity (elastic mesh shrink/grow: device lost or returned).
+            w.mesh = self._parse_mesh(msg)
         w.credit = min(w.credit, w.window)
-        logger.info("worker %s re-advertised capacity=%d prefetch=%d",
-                    w.worker_id, w.capacity, w.prefetch_depth)
+        logger.info("worker %s re-advertised capacity=%d prefetch=%d%s",
+                    w.worker_id, w.capacity, w.prefetch_depth,
+                    " mesh pop=%(pop)d x data=%(data)d" % w.mesh
+                    if w.mesh else "")
         _tele.record_event("worker_readvertised", {
             "worker_id": w.worker_id, "capacity": w.capacity,
-            "prefetch_depth": w.prefetch_depth,
+            "prefetch_depth": w.prefetch_depth, "mesh": w.mesh,
         })
         self._dispatch()

@@ -89,7 +89,18 @@ class GentunClient:
 
     - ``species``: the Individual subclass to rebuild from wire genes.
     - ``capacity``: max jobs held at once (1 = reference semantics; >1 lets
-      a TPU worker train a whole batch in one compiled program).
+      a TPU worker train a whole batch in one compiled program).  The
+      string ``"auto"`` switches on **host-mesh mode**: this worker is one
+      HOST driving all of its local devices through the ``(pop, data)``
+      evaluation mesh, and capacity is DERIVED from that mesh
+      (``parallel.mesh.host_worker_capacity``: compile bucket × pop-axis
+      size) instead of typed in — so the dispatch window is always a
+      shape the compiled evaluator wants, re-advertised via
+      :meth:`remesh` when the device set changes.
+    - ``mesh_devices``: override the probed device count host-mesh mode
+      derives from (default ``jax.device_count()``).  For tests and chaos
+      drills — jax cannot simulate gaining or losing a device in-process —
+      and for non-jax species that want mesh-derived windows anyway.
     - ``prefetch_depth``: jobs queued locally BEYOND ``capacity`` so the
       next window is already decoded when the current one finishes
       (double buffering — a background receive thread feeds a local
@@ -125,8 +136,9 @@ class GentunClient:
         port: int = 5672,
         user: Optional[str] = None,
         password: Optional[str] = None,
-        capacity: int = 1,
+        capacity=1,
         prefetch_depth: Optional[int] = None,
+        mesh_devices: Optional[int] = None,
         heartbeat_interval: float = 3.0,
         reconnect_delay: float = 1.0,
         reconnect_max_delay: float = 30.0,
@@ -143,7 +155,23 @@ class GentunClient:
         self.host = host
         self.port = int(port)
         self.token = password
+        # Host-mesh mode (capacity="auto"): the host is the unit of fleet
+        # membership.  The mesh shape is remembered so the hello/advertise
+        # frames can carry it and the pipelined re-chunker can align
+        # windows to the pop-axis multiple (zero padding waste, one
+        # compiled batch shape).
+        self._mesh_shape: Optional[tuple] = None  # (pop, data) axis sizes
+        self._mesh_devices: Optional[int] = None
+        self._mesh_auto = isinstance(capacity, str)
+        if self._mesh_auto:
+            if str(capacity).strip().lower() != "auto":
+                raise ValueError(
+                    f"capacity must be a positive integer or 'auto', got {capacity!r}")
+            capacity = self._derive_mesh_capacity(mesh_devices)
         self.capacity = max(1, int(capacity))
+        #: True when the operator pinned prefetch explicitly — remesh()
+        #: then respects it instead of tracking the derived capacity.
+        self._prefetch_explicit = prefetch_depth is not None
         if prefetch_depth is None:
             prefetch_depth = self.capacity
         self.prefetch_depth = max(0, min(int(prefetch_depth), 4 * self.capacity))
@@ -220,6 +248,72 @@ class GentunClient:
         self._drain_req = threading.Event()
         self._work_stop: Optional[threading.Event] = None
 
+    # -- host-mesh capacity ------------------------------------------------
+
+    def _derive_mesh_capacity(self, n_devices: Optional[int] = None) -> int:
+        """Capacity from the local device mesh (host-mesh mode).
+
+        ``parallel.mesh.host_worker_capacity``: factor the devices into
+        the ``(pop, data)`` mesh the evaluator will build, then size the
+        window to compile bucket × pop-axis — a shape that shards with
+        zero padding and is already in the compile cache after the first
+        window.  ``n_devices=None`` probes ``jax.device_count()`` (the
+        GLOBAL count: a multihost worker's mesh spans its whole slice),
+        which requires a jax species; tests and non-jax species pass the
+        count explicitly.  Records the shape for the hello/advertise
+        frames, the re-chunker, and the ``mesh_*`` gauges.
+        """
+        from ..parallel.mesh import host_worker_capacity
+
+        if n_devices is None:
+            if not getattr(self.species, "uses_jax", False):
+                raise ValueError(
+                    f"capacity='auto' derives from the local device mesh, but "
+                    f"species {self.species.__name__} never initializes a jax "
+                    f"backend — pass mesh_devices= or an integer capacity")
+            import jax  # the fitness path initializes this backend anyway
+
+            n_devices = max(1, int(jax.device_count()))
+        capacity, pop_axis, data_axis = host_worker_capacity(n_devices)
+        self._mesh_devices = int(n_devices)
+        self._mesh_shape = (pop_axis, data_axis)
+        reg = _get_registry()
+        reg.gauge("mesh_pop_axis").set(pop_axis)
+        reg.gauge("mesh_data_axis").set(data_axis)
+        logger.info(
+            "host-mesh worker %s: %d device(s) -> mesh (pop=%d, data=%d), "
+            "derived capacity %d", self.worker_id if hasattr(self, "worker_id")
+            else "?", n_devices, pop_axis, data_axis, capacity)
+        return capacity
+
+    def _mesh_advert(self) -> Optional[Dict[str, int]]:
+        """The OPTIONAL ``mesh`` wire field (protocol.py "Host-mesh
+        field"), or None for per-chip workers."""
+        if self._mesh_shape is None:
+            return None
+        return {"pop": self._mesh_shape[0], "data": self._mesh_shape[1],
+                "devices": self._mesh_devices or 0}
+
+    def remesh(self, n_devices: Optional[int] = None) -> None:
+        """Re-derive capacity from the current device mesh and re-advertise.
+
+        The elastic half of host-mesh mode: when the host's device set
+        changes (a chip lost to hardware fault, a co-tenant releasing
+        devices, a restarted runtime finding fewer cores), the worker's
+        window must follow — the broker clamps credit immediately on the
+        ``advertise`` frame, in-flight jobs finish unaffected.
+        ``n_devices`` overrides the probe (tests / chaos drills).  Only
+        meaningful in host-mesh mode (``capacity="auto"``).
+        """
+        if not self._mesh_auto:
+            raise ValueError("remesh() requires host-mesh mode (capacity='auto')")
+        capacity = self._derive_mesh_capacity(n_devices)
+        if self._prefetch_explicit:
+            prefetch = min(self.prefetch_depth, 4 * capacity)
+        else:
+            prefetch = capacity  # the derived-window double-buffer default
+        self.advertise(capacity=capacity, prefetch_depth=prefetch)
+
     # -- connection --------------------------------------------------------
 
     def _fleet_chips(self) -> int:
@@ -254,7 +348,7 @@ class GentunClient:
             backend = self.species.fitness_backend()
         except Exception:  # never let an advisory field block the handshake
             backend = None
-        self._send({
+        hello = {
             "type": "hello",
             "worker_id": self.worker_id,
             "token": self.token,
@@ -262,7 +356,13 @@ class GentunClient:
             "prefetch_depth": self.prefetch_depth,
             "n_chips": n_chips,
             "backend": backend,
-        })
+        }
+        mesh = self._mesh_advert()
+        if mesh is not None:
+            # OPTIONAL advisory field (protocol.py "Host-mesh field"):
+            # old brokers ignore unknown hello keys.
+            hello["mesh"] = mesh
+        self._send(hello)
         reply = self._recv()
         if reply.get("type") != "welcome":
             if reply.get("type") == "error" and reply.get("code") == "auth":
@@ -442,6 +542,12 @@ class GentunClient:
             "draining": self._drain_req.is_set(),
             "multihost": self.multihost,
         }
+        if self._mesh_shape is not None:
+            # Host-mesh mode: the shape capacity was derived from.
+            out["mesh"] = {"pop": self._mesh_shape[0],
+                           "data": self._mesh_shape[1],
+                           "devices": self._mesh_devices,
+                           "derived_capacity": self._mesh_auto}
         if self._cache_client is not None:
             out["fitness_service"] = self._cache_client.stats()
         return out
@@ -489,12 +595,16 @@ class GentunClient:
         if prefetch_depth is not None:
             self.prefetch_depth = max(
                 0, min(int(prefetch_depth), 4 * self.capacity))
+        frame = {
+            "type": "advertise",
+            "capacity": self.capacity,
+            "prefetch_depth": self.prefetch_depth,
+        }
+        mesh = self._mesh_advert()
+        if mesh is not None:
+            frame["mesh"] = mesh  # host-mesh shape rides along (OPTIONAL)
         try:
-            self._send({
-                "type": "advertise",
-                "capacity": self.capacity,
-                "prefetch_depth": self.prefetch_depth,
-            })
+            self._send(frame)
         except OSError:
             pass  # reconnect hello re-advertises everything anyway
 
@@ -601,16 +711,16 @@ class GentunClient:
                 while True:
                     msg = self._recv(rfile=rfile)
                     if msg["type"] == "jobs":
-                        jobs = list(msg["jobs"])
                         # Over-subscribed credit can coalesce up to
                         # capacity + prefetch_depth jobs into one frame;
-                        # evaluate in capacity-sized programs so prefetch
-                        # changes WHEN work is decoded, never the compiled
-                        # batch shape — or a poison genome's all-or-nothing
-                        # blast radius (ack-after-work failure reporting
-                        # stays per evaluation group).
-                        for i in range(0, len(jobs), self.capacity):
-                            ready_q.put(jobs[i:i + self.capacity])
+                        # evaluate in capacity-sized (mesh-aligned)
+                        # programs so prefetch changes WHEN work is
+                        # decoded, never the compiled batch shape — or a
+                        # poison genome's all-or-nothing blast radius
+                        # (ack-after-work failure reporting stays per
+                        # evaluation group).
+                        for chunk in self._chunk_jobs(list(msg["jobs"])):
+                            ready_q.put(chunk)
                     elif msg["type"] != "welcome":
                         logger.warning("unexpected message %r", msg["type"])
             except BaseException as e:  # forwarded, re-raised by the consumer
@@ -653,6 +763,26 @@ class GentunClient:
                 self._mh.broadcast_payload(jobs)
             self._evaluate_batch(jobs)
             self._send({"type": "ready", "credit": len(jobs)})
+
+    def _chunk_jobs(self, jobs: List[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
+        """Split a ``jobs`` frame into evaluation-window batches.
+
+        Windows are ``capacity``-sized; in host-mesh mode the window is
+        additionally aligned DOWN to the mesh pop-axis multiple.  A
+        capacity that is not a pop-multiple would pad EVERY window to the
+        next multiple (``eval_pad_waste_total`` climbing forever) and
+        alternate the compiled batch shape between full and tail windows;
+        aligning down keeps every full window on ONE cached compile shape
+        with zero padding.  Only a frame's final partial chunk can land
+        off-multiple — it buckets and pads exactly as a small generation
+        tail always has.  Per-chip workers (integer capacity, no mesh)
+        keep the historical capacity-sized chunking bit-for-bit.
+        """
+        step = self.capacity
+        pop = self._mesh_shape[0] if self._mesh_shape else 1
+        if pop > 1 and step % pop:
+            step = max(pop, step - step % pop)
+        return [jobs[i:i + step] for i in range(0, len(jobs), step)]
 
     def _await_jobs(self) -> List[Dict[str, Any]]:
         while True:

@@ -63,6 +63,21 @@ protocol error):
   granted by the worker's next ``ready``.  Ignored from a draining
   worker.
 
+Host-mesh field (same OPTIONAL convention — pure observability, never
+load-bearing for correctness):
+
+- ``hello`` and ``advertise`` may carry ``mesh`` {pop, data, devices}: a
+  host-level mesh worker (``--capacity auto``, DISTRIBUTED.md "Host-level
+  mesh workers") advertises the ``(pop, data)`` device-mesh factoring its
+  capacity was DERIVED from (compile bucket × pop-axis size) and the
+  local device count behind it.  The broker records it per worker
+  (``/statusz`` fleet table, the gentun_top mesh column) and exposes the
+  fleet's widest pop axis (``fleet_mesh_pop``) so master-side batch
+  sizing can align speculative fill to the mesh multiple.  Malformed
+  values degrade to "no mesh recorded" (like ``n_chips``); a per-chip
+  worker that never sends the field behaves — and is dispatched to —
+  exactly as before.
+
 Multi-fidelity field (same OPTIONAL-with-conservative-default convention):
 
 - each ``jobs`` entry may carry ``fidelity`` {v, rung, fingerprint}: the

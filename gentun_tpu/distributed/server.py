@@ -294,6 +294,25 @@ class DistributedPopulation(Population):
         """
         return self.broker.session_prefetch(self._session_arg)
 
+    def _fill_target(self, n_real, params=None):
+        """Speculative-fill target, additionally aligned to the fleet's
+        widest advertised mesh pop-axis (``JobBroker.fleet_mesh_pop``).
+
+        A host-level mesh worker pads every evaluation window up to its
+        pop-axis multiple regardless of what the master ships
+        (``models/cnn._prepare_population_setup``) — slots the compile
+        bucket alone doesn't predict.  Rounding the fill target to the
+        mesh multiple turns that padding into paid-for speculative
+        trainings whose fitnesses seed the cache, instead of sliced-away
+        waste (``eval_pad_waste_total``).  Fleets with no mesh workers
+        get the base bucket target unchanged.
+        """
+        target = super()._fill_target(n_real, params)
+        multiple = self.broker.fleet_mesh_pop()
+        if multiple > 1 and target % multiple:
+            target += multiple - target % multiple
+        return target
+
     def submit_individuals(self, individuals: Sequence[Individual]) -> List[str]:
         """Ship evaluation jobs without waiting; returns aligned job ids.
 

@@ -9,6 +9,14 @@ it consumes jobs until killed:
         --host <master-ip> --port 5672 --password s3cret \
         --species genetic-cnn --dataset mnist --capacity 8
 
+Host-level mesh worker (ONE worker per host, population sharded across
+every local device — DISTRIBUTED.md "Host-level mesh workers"): pass
+``--capacity auto`` and the worker derives its window from the local
+``(pop, data)`` device mesh (compile bucket × pop-axis size) instead of a
+typed-in number, re-advertising it if the device set changes
+(``GentunClient.remesh``).  A 4-chip host then joins the fleet as one
+member with a mesh-shaped window, not four single-chip members.
+
 All model hyperparameters (``additional_parameters``) arrive from the
 master with each job, so the worker needs only its species and its copy of
 the training data — genes in, fitness out (SURVEY.md §1).  Jobs from a
@@ -120,8 +128,14 @@ def main(argv=None) -> int:
     ap.add_argument("--data-dir", default=None,
                     help="directory with {name}.npz overrides (or $GENTUN_TPU_DATA)")
     ap.add_argument("--n", type=int, default=None, help="subsample the dataset to n examples")
-    ap.add_argument("--capacity", type=int, default=1,
-                    help="jobs taken at once; >1 trains the batch as one vmapped program")
+    ap.add_argument("--capacity", default="1",
+                    help="jobs taken at once; >1 trains the batch as one "
+                         "vmapped program.  'auto' switches on host-level "
+                         "mesh mode: this ONE worker drives every local "
+                         "device through the (pop, data) mesh and derives "
+                         "its capacity from the mesh (compile bucket x "
+                         "pop-axis size) instead of a typed-in number — "
+                         "see DISTRIBUTED.md 'Host-level mesh workers'")
     ap.add_argument("--prefetch-depth", type=int, default=None,
                     help="jobs queued locally BEYOND capacity so the next "
                          "window is decoded while the current one trains "
@@ -187,8 +201,19 @@ def main(argv=None) -> int:
     # library caller may compute them, but a typed-out `--capacity 0` is a
     # mistake the operator should hear about, not a worker that quietly
     # runs with different numbers than its command line says.
-    if args.capacity <= 0:
-        raise SystemExit(f"--capacity must be a positive integer, got {args.capacity}")
+    if str(args.capacity).strip().lower() == "auto":
+        # Host-level mesh worker: capacity derives from the local device
+        # mesh inside GentunClient (after any multihost init below, so a
+        # pod-slice worker derives from its GLOBAL device count).
+        args.capacity = "auto"
+    else:
+        try:
+            args.capacity = int(args.capacity)
+        except ValueError:
+            raise SystemExit(
+                f"--capacity must be a positive integer or 'auto', got {args.capacity!r}")
+        if args.capacity <= 0:
+            raise SystemExit(f"--capacity must be a positive integer, got {args.capacity}")
     if args.prefetch_depth is not None and args.prefetch_depth < 0:
         raise SystemExit(f"--prefetch-depth must be >= 0, got {args.prefetch_depth}")
     if args.ops_port is not None and not 0 <= args.ops_port <= 65535:

@@ -63,7 +63,7 @@ import flax.linen as nn
 import optax
 
 from ..ops.dag import stack_genome_masks
-from ..parallel.mesh import auto_mesh, pad_population, shard_cv_args
+from ..parallel.mesh import auto_mesh, mesh_axis_sizes, pad_population, pop_bucket, shard_cv_args
 from ..parallel.multihost import fetch, place, place_tree
 from ..telemetry import spans as _tele
 from ..utils.jax_state import mark_backend_used
@@ -880,31 +880,11 @@ def _chunked_by_cap(run, genomes, cap_key, run_exact=None):
     return _chunked_by_cap(run, genomes, cap_key, run_exact)
 
 
-def _pop_bucket(n: int) -> int:
-    """Round SMALL population batches up to a power of two (≤ 16).
-
-    The population axis is a compile-time shape: a GA's later generations
-    evaluate whatever the fitness cache didn't answer — small, varying
-    batches (5, 2, 1, ...) — and each distinct size would otherwise pay a
-    full XLA compile (minutes for CIFAR-scale configs).  Bucketing bounds a
-    search to at most {2, 4, 8, 16} small shapes plus the full-population
-    shape; waste is < 2× and only where the absolute cost is small.  Batches
-    ≥ 16 stay exact — they are the dominant cost and occur at one stable
-    size (the full population).
-
-    The floor is 2, not 1: XLA compiles a singleton population axis to a
-    different program (the vmap axis collapses) whose float rounding can
-    flip a prediction vs the same genome trained in a wider batch —
-    breaking the batch-composition purity that ``_genome_hashes`` buys
-    (measured: one-sample accuracy flip at pop=1 on CPU).  Bucket 2 keeps
-    every padded batch on the same multi-slot program family.
-    """
-    if n >= 16:
-        return n
-    b = 2
-    while b < n:
-        b *= 2
-    return b
+# Compile-shape bucketing moved to parallel/mesh.pop_bucket so the
+# dispatch plane derives worker capacity from the SAME policy the
+# evaluator compiles to (host_worker_capacity); the historical name stays
+# importable here.  populations._compile_bucket is the jax-free mirror.
+_pop_bucket = pop_bucket
 
 
 def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str, Any]]):
@@ -951,6 +931,20 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
         genomes, n_real = pad_population(genomes, target)
     else:
         genomes, n_real = pad_population(genomes, multiple)
+    # Mesh observability: the axis sizes this evaluation actually shards
+    # over, and the padding slots this batch wastes (slots trained whose
+    # results are sliced away — a mesh-aligned dispatch schedule keeps
+    # this at 0; see DISTRIBUTED.md "Host-level mesh workers").  Plain
+    # registry writes — a couple of dict ops, cheap enough to stay
+    # unconditional so `/metrics` is truthful even with spans off.
+    from ..telemetry.registry import get_registry as _get_registry
+
+    _reg = _get_registry()
+    _pop_ax, _data_ax = mesh_axis_sizes(mesh)
+    _reg.gauge("mesh_pop_axis").set(_pop_ax)
+    _reg.gauge("mesh_data_axis").set(_data_ax)
+    if len(genomes) > n_real:
+        _reg.counter("eval_pad_waste_total").inc(len(genomes) - n_real)
     stacked = [
         {k: jnp.asarray(v) for k, v in stage.items()}
         for stage in stack_genome_masks(genomes, cfg["nodes"])

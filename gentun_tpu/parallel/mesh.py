@@ -35,7 +35,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .multihost import place, place_tree
 
-__all__ = ["auto_mesh", "pad_population", "shard_cv_args", "mesh_axis_sizes"]
+__all__ = [
+    "auto_mesh",
+    "pad_population",
+    "shard_cv_args",
+    "mesh_axis_sizes",
+    "mesh_factor",
+    "pop_bucket",
+    "host_worker_capacity",
+]
 
 
 def _largest_divisor_leq(n: int, cap: int) -> int:
@@ -44,6 +52,86 @@ def _largest_divisor_leq(n: int, cap: int) -> int:
         if n % d == 0:
             return d
     return 1
+
+
+def mesh_factor(n_devices: int, pop_size: Optional[int] = None) -> Tuple[int, int]:
+    """The ``(pop, data)`` factoring :func:`auto_mesh` would build.
+
+    Pure integer math — no device objects, no backend init — so the
+    dispatch plane (worker capacity derivation, broker-side sizing) can
+    reason about mesh shapes without touching jax.  Kept as THE factoring
+    authority: ``auto_mesh`` calls this, which is what guarantees a
+    worker's advertised mesh shape and its evaluation mesh agree.
+    """
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    cap = n if pop_size is None else max(1, int(pop_size))
+    pop_axis = _largest_divisor_leq(n, cap)
+    return pop_axis, n // pop_axis
+
+
+def pop_bucket(n: int) -> int:
+    """Round SMALL population batches up to a power of two (≤ 16).
+
+    The population axis is a compile-time shape: a GA's later generations
+    evaluate whatever the fitness cache didn't answer — small, varying
+    batches (5, 2, 1, ...) — and each distinct size would otherwise pay a
+    full XLA compile (minutes for CIFAR-scale configs).  Bucketing bounds a
+    search to at most {2, 4, 8, 16} small shapes plus the full-population
+    shape; waste is < 2× and only where the absolute cost is small.  Batches
+    ≥ 16 stay exact — they are the dominant cost and occur at one stable
+    size (the full population).
+
+    The floor is 2, not 1: XLA compiles a singleton population axis to a
+    different program (the vmap axis collapses) whose float rounding can
+    flip a prediction vs the same genome trained in a wider batch —
+    breaking the batch-composition purity that ``_genome_hashes`` buys
+    (measured: one-sample accuracy flip at pop=1 on CPU).  Bucket 2 keeps
+    every padded batch on the same multi-slot program family.
+
+    Canonical definition (``models/cnn._pop_bucket`` aliases it;
+    ``populations._compile_bucket`` mirrors it jax-free — the lockstep
+    test in ``tests/test_populations_speculative.py`` covers all three).
+    """
+    if n >= 16:
+        return n
+    b = 2
+    while b < n:
+        b *= 2
+    return b
+
+
+def host_worker_capacity(n_devices: int, slots_per_device: int = 2) -> Tuple[int, int, int]:
+    """Derive a host-level worker's capacity from its local device mesh.
+
+    Returns ``(capacity, pop_axis, data_axis)``.  The host (not the chip)
+    is the unit of fleet membership: one worker drives every local device
+    through the ``(pop, data)`` mesh, and its dispatch window must be a
+    shape the compiled evaluator actually wants — so capacity is derived,
+    never typed in:
+
+    - start from ``slots_per_device × pop_axis`` (default 2 per device:
+      the compile-bucket floor, so even a 1-device host evaluates on the
+      stable multi-slot program family);
+    - round up to the compile bucket (:func:`pop_bucket`), so a full
+      window is one already-cached compile shape;
+    - if the bucket shape and the pop-axis size disagree (non-power-of-two
+      device counts), step up into the exact-shape regime (≥ 16) and round
+      to the next pop-axis multiple — every full window then shards with
+      ZERO padding waste.
+
+    Power-of-two hosts land on {2, 4, 8, 16} for 1/2/4/8 devices: always
+    a compile bucket AND a pop-axis multiple, so steady-state windows
+    never pad and never recompile.
+    """
+    pop_axis, data_axis = mesh_factor(n_devices)
+    cap = pop_axis * max(1, int(slots_per_device))
+    b = pop_bucket(cap)
+    if b % pop_axis:
+        b = max(16, cap)
+        b += (-b) % pop_axis
+    return b, pop_axis, data_axis
 
 
 def auto_mesh(
@@ -60,21 +148,33 @@ def auto_mesh(
     one-chip path stays annotation-free.
 
     Explicit ``pop_axis``/``data_axis`` override the heuristic (their
-    product must equal the device count).
+    product must equal the device count; non-positive values are a loud
+    ``ValueError`` — ``pop_axis=0`` used to fall into an ``or`` falsy
+    trap and silently meant "unset", which is exactly the kind of typo a
+    32-device launch script should hear about).
     """
+    # Validate explicit overrides BEFORE the single-device early return:
+    # a typo like pop_axis=0 must be loud on every topology, not only
+    # where it happens to reach the factoring math.
+    for name, axis in (("pop_axis", pop_axis), ("data_axis", data_axis)):
+        if axis is not None and axis < 1:
+            raise ValueError(
+                f"{name} must be a positive integer, got {axis} "
+                f"(omit the argument to let auto_mesh factor the "
+                f"devices itself)")
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if n == 1:
         return None
     if pop_axis is not None or data_axis is not None:
-        pop_axis = pop_axis or (n // (data_axis or 1))
-        data_axis = data_axis or (n // pop_axis)
+        if pop_axis is None:
+            pop_axis = n // data_axis
+        elif data_axis is None:
+            data_axis = n // pop_axis
         if pop_axis * data_axis != n:
             raise ValueError(f"pop_axis*data_axis = {pop_axis}*{data_axis} != {n} devices")
     else:
-        cap = n if pop_size is None else max(1, pop_size)
-        pop_axis = _largest_divisor_leq(n, cap)
-        data_axis = n // pop_axis
+        pop_axis, data_axis = mesh_factor(n, pop_size)
     mesh_devices = np.asarray(devices).reshape(pop_axis, data_axis)
     return Mesh(mesh_devices, axis_names=("pop", "data"))
 

@@ -36,7 +36,14 @@ class NoopIndividual(Individual):
         return float(sum(sum(g) for g in self.genes.values()))
 
 
-def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
+def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16,
+        n_sessions: int = 1) -> dict:
+    """One benchmark pass.  ``n_sessions=1`` is the single-tenant path
+    (the fair-share scheduler degenerates to FIFO: one lane, no quota or
+    weight bookkeeping on the hot path); ``n_sessions>1`` splits the same
+    job count across that many open sessions round-robin, exercising the
+    weighted-DRR dispatch lanes + per-session books for real — the delta
+    between the two is the multi-tenant scheduler's per-job overhead."""
     data = (np.zeros(1, np.float32), np.zeros(1, np.float32))
     rng = np.random.default_rng(0)
     payloads = {
@@ -73,7 +80,15 @@ def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
             t.start()
             threads.append(t)
         t0 = time.monotonic()
-        broker.submit(payloads)
+        if n_sessions > 1:
+            sessions = [broker.open_session(f"bench-{s}") for s in range(n_sessions)]
+            shares = [{} for _ in sessions]
+            for i, (job_id, payload) in enumerate(payloads.items()):
+                shares[i % n_sessions][job_id] = payload
+            for sess, share in zip(sessions, shares):
+                broker.submit(share, session=sess)
+        else:
+            broker.submit(payloads)
         results = broker.gather(list(payloads), timeout=120.0)
         wall = time.monotonic() - t0
         assert len(results) == n_jobs
@@ -82,6 +97,7 @@ def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
             "n_jobs": n_jobs,
             "n_workers": n_workers,
             "capacity": capacity,
+            "n_sessions": n_sessions,
             "wall_s": round(wall, 3),
             "jobs_per_sec": round(n_jobs / wall, 1),
             # one chip consumes ~6.2 proxy jobs/sec (bench.py ≈22.2k/hour)
@@ -99,6 +115,27 @@ def run(n_jobs: int = 2000, n_workers: int = 4, capacity: int = 16) -> dict:
         spans_mod.disable()
 
 
-if __name__ == "__main__":
+def main() -> dict:
+    # Single-tenant pass first (the historical headline numbers), then the
+    # same workload split across 4 fair-share sessions: the difference is
+    # the weighted-DRR scheduler's control-plane cost per job, made
+    # visible here so a scheduler regression shows up in the artifact, not
+    # in a production master's throughput graph.
     out = run()
-    print(json.dumps(out))
+    multi = run(n_sessions=4)
+    single_rate, drr_rate = out["jobs_per_sec"], multi["jobs_per_sec"]
+    out["scheduler"] = {
+        "single_tenant_fifo_jobs_per_sec": single_rate,
+        "drr_4_sessions_jobs_per_sec": drr_rate,
+        # Per-job cost of the DRR path vs the single-lane pop: positive =
+        # overhead, small negative = noise floor (the runs race real
+        # sockets and threads).
+        "per_job_overhead_us": round((1.0 / drr_rate - 1.0 / single_rate) * 1e6, 1),
+        "overhead_pct": round((single_rate - drr_rate) / single_rate * 100.0, 2),
+        "drr_dispatch_rtt_s": multi["dispatch_rtt_s"],
+    }
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

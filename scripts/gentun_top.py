@@ -43,7 +43,15 @@ _HEADLINE_COUNTERS = (
     "worker_drains_total",
     "session_rejected_total",
     "session_quarantined_total",
+    "eval_pad_waste_total",
 )
+
+
+def _fmt_mesh(mesh):
+    """'8×1' for a host-mesh worker's {pop, data} advertisement, '-' else."""
+    if not isinstance(mesh, dict):
+        return "-"
+    return f"{mesh.get('pop', '?')}x{mesh.get('data', '?')}"
 
 
 def _get(url: str, timeout: float):
@@ -151,7 +159,7 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
         workers = fleet.get("workers", [])
         if workers:
             lines.append(f"  {D}{'worker':<16}{'cap':>4}{'pre':>4}{'credit':>7}"
-                         f"{'busy':>5}{'chips':>6}{'seen':>8}  backend{X}")
+                         f"{'busy':>5}{'chips':>6}{'mesh':>7}{'seen':>8}  backend{X}")
             for w in workers:
                 lines.append(
                     f"  {str(w.get('worker_id', '?'))[:16]:<16}"
@@ -160,6 +168,7 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                     f"{w.get('credit', '-'):>7}"
                     f"{w.get('jobs_in_flight', '-'):>5}"
                     f"{w.get('n_chips', '-'):>6}"
+                    f"{_fmt_mesh(w.get('mesh')):>7}"
                     f"{_fmt_age(w.get('last_seen_age_s')):>8}  "
                     f"{w.get('backend') or '-'}"
                     + (f"  {Y}DRAINING{X}" if w.get("draining") else ""))
@@ -194,6 +203,26 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                      f"{'connected' if worker.get('connected') else 'DISCONNECTED'}"
                      + (f"  {Y}DRAINING{X}" if worker.get("draining") else ""))
 
+    # Mesh panel (host-level mesh workers, DISTRIBUTED.md): the local
+    # evaluation mesh's axis sizes — from the worker's /statusz block when
+    # available (includes the device count capacity derives from), else
+    # from the mesh_* gauges any mesh-sharded evaluator sets — plus the
+    # cumulative padding-slot waste counter the aligned dispatch schedule
+    # is supposed to hold at zero.
+    totals = _parse_counters(metrics_text or "")
+    mesh = (worker or {}).get("mesh")
+    if mesh or "mesh_pop_axis" in totals:
+        if mesh:
+            shape = (f"pop {mesh.get('pop')} × data {mesh.get('data')}  "
+                     f"devices {mesh.get('devices', '-')}"
+                     + ("  (capacity derived)" if mesh.get("derived_capacity") else ""))
+        else:
+            shape = (f"pop {totals['mesh_pop_axis']:g} × "
+                     f"data {totals.get('mesh_data_axis', 1):g}")
+        waste = totals.get("eval_pad_waste_total", 0)
+        wcol = f"{R}{waste:g}{X}" if waste else f"{G}0{X}"
+        lines.append(f"{B}mesh{X}  {shape}  pad-waste {wcol}")
+
     # Shared fitness-cache panel: the "fitness_service" status provider is
     # registered by whichever side runs a FitnessServiceClient (master via
     # cache_url=, worker via --cache-url → client _ops_status block).
@@ -208,7 +237,6 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                      f"pending-publish {cache.get('pending_publish')}  "
                      f"local {cache.get('local_entries', '-')}")
 
-    totals = _parse_counters(metrics_text or "")
     headline = [(n, totals[n]) for n in _HEADLINE_COUNTERS if n in totals]
     if headline:
         lines.append(f"{B}counters{X}  " + "  ".join(

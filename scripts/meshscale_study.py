@@ -1,0 +1,201 @@
+"""Host-level mesh worker scaling study: individuals/hour/host vs devices.
+
+The host-level worker (DISTRIBUTED.md "Host-level mesh workers") joins the
+fleet as ONE member driving every local device through the ``(pop, data)``
+mesh, with ``--capacity auto`` deriving its dispatch window from the mesh
+(compile bucket × pop-axis size).  This study measures what that buys and
+verifies what it must not cost:
+
+1. **Device sweep** {1, 2, 4, 8}: one worker subprocess per phase with
+   ``--xla_force_host_platform_device_count=D`` simulated CPU devices,
+   same 16-genome population each time, recording wall time and
+   individuals/hour/host.  The derived capacities (2/4/8/16) mean every
+   full dispatch window is one already-cached compile shape sharding with
+   zero padding.
+2. **Bit-identity gate**: every mesh run's fitnesses must be EXACTLY the
+   single-device reference's, genome for genome — the mesh moves where a
+   genome trains, never what it measures (batch-composition purity via
+   per-genome fold keys, ``models/cnn.py``).  The study FAILS loudly
+   otherwise.
+3. **Fleet-consolidation E2E**: one 8-device host-level worker vs eight
+   single-device workers on the same search — identical best fitness,
+   broker quiescent (zero outstanding jobs) after both.
+
+Honesty note: simulated CPU devices share one physical core, so the sweep
+demonstrates control-plane consolidation (one fleet member, one socket, one
+derived window instead of eight) and compile-shape stability — NOT compute
+speedup.  On real multi-chip hosts the pop axis is communication-free
+scale-out; here the numbers mostly show that consolidation costs nothing.
+
+CPU-only, a few minutes: ``python scripts/meshscale_study.py``.
+Writes ``scripts/meshscale_study.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gentun_tpu.distributed import DistributedPopulation  # noqa: E402
+from gentun_tpu.individuals import GeneticCnnIndividual  # noqa: E402
+from gentun_tpu.parallel.mesh import host_worker_capacity  # noqa: E402
+
+# Tiny-but-real GeneticCnn schedule (the tier-1 bitwise tests' shape):
+# small enough for CPU, real enough that fitness is a trained accuracy.
+PARAMS = dict(nodes=(3,), kernels_per_layer=(6,), kfold=2, epochs=(1,),
+              learning_rate=(0.05,), batch_size=32, dense_units=16,
+              compute_dtype="float32", seed=0)
+POP_SIZE = 16      # one full derived window on the 8-device host
+POP_SEED = 11      # master-side genome init is jax-free → identical per phase
+N_EXAMPLES = 64    # workers subsample their (deterministic) local dataset
+DEVICE_SWEEP = (1, 2, 4, 8)
+
+
+def _spawn_worker(port: int, n_devices: int, worker_id: str) -> subprocess.Popen:
+    """One worker subprocess with ``n_devices`` simulated CPU devices.
+
+    ``--capacity auto`` is the point of the study: the worker derives its
+    window from the forced device mesh, exactly as a real multi-chip host
+    would from its local chips.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "gentun_tpu.distributed.worker",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--species", "genetic-cnn", "--dataset", "mnist", "--n", str(N_EXAMPLES),
+         "--capacity", "auto", "--worker-id", worker_id],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _stop_workers(procs) -> None:
+    for p in procs:
+        p.terminate()  # SIGTERM = orderly drain (worker.py signal handler)
+    for p in procs:
+        try:
+            p.wait(timeout=20.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10.0)
+
+
+def _run_phase(n_workers: int, devices_per_worker: int, label: str) -> dict:
+    """One full fitness sweep against a freshly spawned worker fleet."""
+    pop = DistributedPopulation(
+        GeneticCnnIndividual, size=POP_SIZE, seed=POP_SEED,
+        additional_parameters=dict(PARAMS), port=0, job_timeout=900.0,
+    )
+    procs = []
+    try:
+        _, port = pop.broker_address
+        procs = [_spawn_worker(port, devices_per_worker, f"{label}-w{i}")
+                 for i in range(n_workers)]
+        t0 = time.monotonic()
+        evaluated = pop.evaluate()
+        wall = time.monotonic() - t0
+        fits = {repr(ind.cache_key()): ind.get_fitness() for ind in pop}
+        best = max(ind.get_fitness() for ind in pop)
+        outstanding = pop.broker.outstanding()
+        cap, pop_ax, data_ax = host_worker_capacity(devices_per_worker)
+        return {
+            "label": label,
+            "n_workers": n_workers,
+            "devices_per_worker": devices_per_worker,
+            "derived_capacity": cap,
+            "mesh": {"pop": pop_ax, "data": data_ax},
+            "evaluated": evaluated,
+            "wall_s": round(wall, 2),
+            "individuals_per_hour_per_host": round(evaluated / wall * 3600.0, 1)
+            if wall > 0 else None,
+            "best_fitness": best,
+            "fitnesses": fits,
+            "outstanding_total": sum(outstanding.values()),
+        }
+    finally:
+        _stop_workers(procs)
+        pop.close()
+
+
+def main() -> dict:
+    out = {
+        "config": {"params": {k: list(v) if isinstance(v, tuple) else v
+                              for k, v in PARAMS.items()},
+                   "pop_size": POP_SIZE, "pop_seed": POP_SEED,
+                   "n_examples": N_EXAMPLES},
+        "note": ("simulated CPU devices share one core: this measures "
+                 "control-plane consolidation and compile-shape stability, "
+                 "not compute speedup"),
+        "sweep": [],
+    }
+    reference = None
+    failures = []
+    for d in DEVICE_SWEEP:
+        print(f"[meshscale] sweep: 1 worker x {d} device(s) ...", flush=True)
+        phase = _run_phase(n_workers=1, devices_per_worker=d, label=f"mesh{d}")
+        if d == 1:
+            reference = phase
+            phase["bit_identical_to_1dev"] = True
+        else:
+            phase["bit_identical_to_1dev"] = (
+                phase["fitnesses"] == reference["fitnesses"])
+            if not phase["bit_identical_to_1dev"]:
+                failures.append(
+                    f"{phase['label']}: fitnesses diverge from 1-device reference")
+        out["sweep"].append(phase)
+        print(f"[meshscale]   cap={phase['derived_capacity']} "
+              f"mesh={phase['mesh']['pop']}x{phase['mesh']['data']} "
+              f"wall={phase['wall_s']}s "
+              f"rate={phase['individuals_per_hour_per_host']}/hr/host "
+              f"bit_identical={phase['bit_identical_to_1dev']}", flush=True)
+
+    # Fleet consolidation: ONE 8-device host-level member replaces EIGHT
+    # single-device members.  The 8-device sweep phase above is the
+    # consolidated side; run the 8x1 fleet against the same population.
+    print("[meshscale] e2e: 8 workers x 1 device ...", flush=True)
+    fleet = _run_phase(n_workers=8, devices_per_worker=1, label="fleet8x1")
+    consolidated = next(p for p in out["sweep"] if p["devices_per_worker"] == 8)
+    e2e = {
+        "consolidated": {k: consolidated[k] for k in
+                         ("label", "n_workers", "devices_per_worker",
+                          "derived_capacity", "best_fitness",
+                          "outstanding_total", "wall_s")},
+        "fleet": {k: fleet[k] for k in
+                  ("label", "n_workers", "devices_per_worker",
+                   "derived_capacity", "best_fitness",
+                   "outstanding_total", "wall_s")},
+        "best_fitness_identical": fleet["best_fitness"] == consolidated["best_fitness"],
+        "fitnesses_identical": fleet["fitnesses"] == consolidated["fitnesses"],
+        "both_quiescent": (fleet["outstanding_total"] == 0
+                           and consolidated["outstanding_total"] == 0),
+    }
+    if not e2e["best_fitness_identical"]:
+        failures.append("e2e: consolidated vs fleet best fitness differs")
+    if not e2e["both_quiescent"]:
+        failures.append("e2e: broker not quiescent after final gather")
+    out["e2e_one_host_replaces_fleet"] = e2e
+    out["ok"] = not failures
+    out["failures"] = failures
+    # The full per-genome maps made the gate auditable; keep the artifact
+    # readable by dropping them from the sweep entries (reference kept).
+    for p in out["sweep"][1:]:
+        del p["fitnesses"]
+    path = os.path.join(REPO, "scripts", "meshscale_study.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(out, fh, indent=2)
+    print(f"[meshscale] wrote {path} ok={out['ok']}", flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    result = main()
+    raise SystemExit(0 if result["ok"] else 1)

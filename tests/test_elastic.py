@@ -139,6 +139,55 @@ class TestBrokerMembership:
             pop.close()
 
 
+class TestElasticMeshShrink:
+    def test_device_loss_readvertises_and_completes(self):
+        """A host-level mesh worker that loses devices mid-run re-derives
+        its capacity (``remesh``) and re-advertises through the elastic
+        membership path: the broker clamps its dispatch window at once,
+        the fleet's mesh multiple follows, and every in-flight job still
+        completes — device loss degrades throughput, never the search."""
+        pop = DistributedPopulation(SlowOneMax, size=24, seed=2, port=0,
+                                    maximize=True, job_timeout=60)
+        stop = threading.Event()
+        try:
+            _, port = pop.broker_address
+            client = GentunClient(
+                SlowOneMax, *DATA, host="127.0.0.1", port=port,
+                capacity="auto", mesh_devices=8, worker_id="shrink-w0",
+                heartbeat_interval=0.2, reconnect_delay=0.05,
+            )
+            t = threading.Thread(target=lambda: client.work(stop_event=stop),
+                                 daemon=True)
+            t.start()
+            assert _wait(lambda: pop.fleet_capacity() == 16)
+            assert pop.broker.fleet_mesh_pop() == 8
+            done = []
+
+            def master():
+                pop.evaluate()
+                done.append(True)
+
+            mt = threading.Thread(target=master, daemon=True)
+            mt.start()
+            # wait until jobs are genuinely in flight on the worker ...
+            assert _wait(lambda: any(
+                len(w.in_flight) > 0
+                for w in list(pop.broker._workers.values())))
+            # ... then lose 6 of the 8 devices
+            client.remesh(n_devices=2)
+            assert client.capacity == 4
+            assert _wait(lambda: pop.fleet_capacity() == 4)
+            assert _wait(lambda: pop.broker.fleet_mesh_pop() == 2)
+            w = next(iter(pop.broker._workers.values()))
+            assert w.credit <= w.window  # clamped immediately, not at drain
+            mt.join(timeout=60)
+            assert done and all(i.fitness_evaluated for i in pop)
+            assert sum(pop.broker.outstanding().values()) == 0
+        finally:
+            stop.set()
+            pop.close()
+
+
 class TestStaleFleetSizing:
     def test_async_in_flight_target_follows_disconnect(self):
         """Regression: the engine resolved its in-flight target ONCE at

@@ -11,7 +11,14 @@ import pytest
 import jax
 
 from gentun_tpu.models.cnn import GeneticCnnModel
-from gentun_tpu.parallel.mesh import auto_mesh, mesh_axis_sizes, pad_population
+from gentun_tpu.parallel.mesh import (
+    auto_mesh,
+    host_worker_capacity,
+    mesh_axis_sizes,
+    mesh_factor,
+    pad_population,
+    pop_bucket,
+)
 
 FAST = dict(
     nodes=(3,),
@@ -61,6 +68,50 @@ class TestMeshConstruction:
     def test_single_device_returns_none(self):
         assert auto_mesh(pop_size=4, devices=jax.devices()[:1]) is None
 
+    def test_nonpositive_axis_override_is_loud(self):
+        """pop_axis=0 used to fall into an `or` falsy trap and silently
+        mean "unset"; any non-positive override must raise."""
+        with pytest.raises(ValueError, match="pop_axis"):
+            auto_mesh(pop_axis=0)
+        with pytest.raises(ValueError, match="data_axis"):
+            auto_mesh(data_axis=0)
+        with pytest.raises(ValueError, match="pop_axis"):
+            auto_mesh(pop_axis=-2, data_axis=4)
+        # ... on EVERY topology, including the single device where
+        # auto_mesh otherwise early-returns None before factoring.
+        with pytest.raises(ValueError, match="pop_axis"):
+            auto_mesh(pop_axis=0, devices=jax.devices()[:1])
+
+    def test_mesh_factor_matches_auto_mesh(self):
+        """mesh_factor is the jax-free factoring authority: the dispatch
+        plane's view and the evaluator's built mesh must agree."""
+        for pop_size in (None, 1, 3, 4, 16):
+            mesh = auto_mesh(pop_size=pop_size)
+            assert mesh_axis_sizes(mesh) == mesh_factor(8, pop_size)
+        with pytest.raises(ValueError):
+            mesh_factor(0)
+
+    def test_host_worker_capacity_derivation(self):
+        # power-of-two hosts land on a compile bucket that is also a
+        # pop-axis multiple: zero padding, one compiled shape
+        assert host_worker_capacity(1) == (2, 1, 1)
+        assert host_worker_capacity(2) == (4, 2, 1)
+        assert host_worker_capacity(4) == (8, 4, 1)
+        assert host_worker_capacity(8) == (16, 8, 1)
+        # non-power-of-two: bucket 16 isn't a multiple of pop=6 — step
+        # into the exact-shape regime and round up to the pop multiple
+        assert host_worker_capacity(6) == (18, 6, 1)
+        assert host_worker_capacity(4, slots_per_device=4) == (16, 4, 1)
+
+    def test_pop_bucket_is_canonical(self):
+        """mesh.pop_bucket, the cnn alias, and the populations jax-free
+        mirror are one policy (capacity derivation depends on it)."""
+        from gentun_tpu.models.cnn import _pop_bucket
+        from gentun_tpu.populations import _compile_bucket
+
+        for n in range(1, 40):
+            assert pop_bucket(n) == _pop_bucket(n) == _compile_bucket(n)
+
     def test_pad_population(self):
         genomes = [{"S_1": (0, 0, 0)}, {"S_1": (1, 0, 1)}, {"S_1": (1, 1, 1)}]
         padded, n = pad_population(genomes, 4)
@@ -98,6 +149,30 @@ class TestShardedTraining:
         accs = GeneticCnnModel.cross_validate_population(x, y, genomes, **cfg)
         assert accs.shape == (3,)
         assert (accs > 0.4).all()
+
+    def test_pad_waste_metrics(self, separable_data):
+        """Mesh observability: a mesh-aligned batch wastes zero padding
+        slots (``eval_pad_waste_total`` stays 0 — what a host-level
+        worker's aligned dispatch schedule guarantees); a misaligned one
+        counts exactly its sliced-away slots.  Axis gauges reflect the
+        mesh the evaluation actually sharded over."""
+        from gentun_tpu.telemetry.registry import get_registry
+
+        x, y = separable_data
+        reg = get_registry()
+        reg.reset()
+        cfg = dict(FAST)
+        cfg["mesh"] = auto_mesh(pop_axis=8, data_axis=1)
+        # aligned: all 8 possible 3-bit genomes fill the (8, 1) mesh
+        genomes8 = [{"S_1": (i & 1, (i >> 1) & 1, (i >> 2) & 1)} for i in range(8)]
+        GeneticCnnModel.cross_validate_population(x, y, genomes8, **cfg)
+        assert reg.counter("eval_pad_waste_total").value == 0
+        assert reg.gauge("mesh_pop_axis").value == 8
+        assert reg.gauge("mesh_data_axis").value == 1
+        # misaligned: 3 genomes pad to the mesh's 8 slots — 5 wasted
+        GeneticCnnModel.cross_validate_population(x, y, genomes8[:3], **cfg)
+        assert reg.counter("eval_pad_waste_total").value == 5
+        reg.reset()
 
     def test_auto_mesh_is_default(self, separable_data):
         """mesh='auto' engages the 8-device mesh without explicit config."""
