@@ -27,6 +27,7 @@ import numpy as np
 from .individuals import Individual
 from .populations import Population
 from .telemetry import health as _health
+from .telemetry import lineage as _lineage
 from .telemetry import spans as _tele
 from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
 
@@ -153,10 +154,21 @@ class GeneticAlgorithm:
             if self.elitism:
                 next_individuals.append(fittest.copy())  # keeps cached fitness
             with _tele.span("reproduce"):
+                lin = _lineage.enabled()
                 while len(next_individuals) < len(self.population):
                     mother = self.select_parent()
                     father = self.select_parent()
-                    next_individuals.append(mother.reproduce(father, self.rng))
+                    child = mother.reproduce(father, self.rng)
+                    if lin:
+                        _lineage.record(
+                            "born", _lineage.genome_key(child.get_genes()),
+                            parents=[
+                                _lineage.genome_key(mother.get_genes()),
+                                _lineage.genome_key(father.get_genes()),
+                            ],
+                            op="reproduce",
+                            generation=self.generation + 1)
+                    next_individuals.append(child)
 
             # clone_with keeps the population's concrete type across
             # generations (a DistributedPopulation must carry its broker

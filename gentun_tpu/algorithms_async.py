@@ -66,6 +66,7 @@ import numpy as np
 from .individuals import Individual
 from .populations import Population
 from .telemetry import health as _health
+from .telemetry import lineage as _lineage
 from .telemetry import spans as _tele
 from .telemetry.registry import get_registry as _get_registry
 from .utils.fitness_store import (
@@ -129,13 +130,34 @@ class _LocalEvaluator:
 
     def submit(self, individuals: List[Individual]) -> List[int]:
         tokens = []
+        lin = _lineage.enabled()
         for ind in individuals:
             token = next(self._seq)
-            fut = self._pool.submit(ind.get_fitness)
+            fn = self._timed_fitness(ind) if lin else ind.get_fitness
+            fut = self._pool.submit(fn)
             fut.add_done_callback(lambda f, t=token: self._done.put((t, f)))
             self._futures[token] = fut
             tokens.append(token)
         return tokens
+
+    @staticmethod
+    def _timed_fitness(ind: Individual):
+        """Forensics wrapper: attribute the evaluation's device-seconds to
+        the genome (docs/OBSERVABILITY.md "Search forensics").  Charged
+        even when the evaluation raises — the chip time was spent."""
+        def run():
+            t0 = time.monotonic()
+            try:
+                return ind.get_fitness()
+            finally:
+                if not _tele.capturing():
+                    _lineage.emit_device(
+                        time.monotonic() - t0,
+                        _lineage.genome_key(ind.get_genes()),
+                        rung=(getattr(ind, "_fidelity_tag", None)
+                              or {}).get("rung", 0),
+                        start_monotonic=t0)
+        return run
 
     def wait_any(self, timeout: Optional[float]) -> List[_Event]:
         try:
@@ -544,6 +566,12 @@ class AsyncEvolution:
                 child = self.population.spawn(
                     genes=child.get_genes(),
                     additional_parameters=self._ladder[0])
+            if _lineage.enabled():
+                _lineage.record(
+                    "born", _lineage.genome_key(child.get_genes()),
+                    parents=[_lineage.genome_key(mother.get_genes()),
+                             _lineage.genome_key(father.get_genes())],
+                    op="reproduce")
             return child
 
     def _tag_fidelity(self, work: _Work) -> None:
@@ -588,6 +616,11 @@ class AsyncEvolution:
                 if tele:
                     _get_registry().counter(
                         "fitness_cache_hits_total", rung=str(work.rung)).inc()
+                if _lineage.enabled():
+                    _lineage.record(
+                        "cache_hit",
+                        _lineage.genome_key(work.ind.get_genes()),
+                        source="local", rung=work.rung)
                 self._complete(work, float(cached), cached=True)
                 continue
             if tele:
@@ -597,6 +630,11 @@ class AsyncEvolution:
             if token is not None:
                 self._followers.setdefault(token, []).append(work)
                 self._track_open(work)
+                if _lineage.enabled():
+                    _lineage.record(
+                        "follower_attach",
+                        _lineage.genome_key(work.ind.get_genes()),
+                        rung=work.rung)
                 continue
             to_submit.append((work, key))
         if to_submit:
@@ -669,10 +707,20 @@ class AsyncEvolution:
                 evicted = self.population.evict_oldest()
                 if evicted is not None:
                     self._cancel_promotions_for(evicted)
+                    if _lineage.enabled():
+                        _lineage.record(
+                            "evicted",
+                            _lineage.genome_key(evicted.get_genes()))
         elif self._ladder is not None:
             ind._rung = work.rung
         self._update_best(work, float(fitness))
         self.completed += 1
+        if _lineage.enabled():
+            _lineage.record(
+                "completed", _lineage.genome_key(ind.get_genes()),
+                fitness=float(fitness), rung=work.rung,
+                cached=bool(cached) or None,
+                promotion=(work.target is not None and work.rung > 0) or None)
         entry = {
             "completed": self.completed,
             "fitness": float(fitness),
@@ -757,6 +805,7 @@ class AsyncEvolution:
         candidates.sort(key=lambda m: m.get_fitness(),
                         reverse=self.population.maximize)
         tele = _tele.enabled()
+        lin = _lineage.enabled()
         for member in candidates[:open_slots]:
             probe = self.population.spawn(
                 genes=member.get_genes(),
@@ -766,6 +815,10 @@ class AsyncEvolution:
             if tele:
                 _get_registry().counter(
                     "promotions_total", rung=str(rung + 1)).inc()
+            if lin:
+                _lineage.record(
+                    "promoted", _lineage.genome_key(member.get_genes()),
+                    from_rung=rung, to_rung=rung + 1)
 
     def _cancel_promotions_for(self, member: Individual) -> None:
         """Withdraw any queued or in-flight promotion probe targeting an
@@ -809,6 +862,10 @@ class AsyncEvolution:
         retries the same doomed promotion)."""
         logger.warning("async evaluation failed permanently: %s", reason)
         ind = work.ind
+        if _lineage.enabled():
+            _lineage.record(
+                "failed", _lineage.genome_key(ind.get_genes()),
+                rung=work.rung, reason=str(reason)[:200])
         self._open_children.pop(id(ind), None)
         if work.target is not None:
             work.target._promo_pending = False

@@ -46,6 +46,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Set
 
 from ..telemetry import health as _health
+from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
@@ -1010,9 +1011,22 @@ class JobBroker:
                         # The registry twin of the span: a per-job wait
                         # histogram dashboards can read without span
                         # post-processing (tail-regime pressure signal).
-                        _get_registry().histogram("queue_wait_s").observe(wait)
+                        # Session-labeled only for tenant jobs, so the
+                        # single-tenant series name never changes.
+                        if sid != DEFAULT_SESSION:
+                            _get_registry().histogram(
+                                "queue_wait_s", session=sid).observe(wait)
+                        else:
+                            _get_registry().histogram("queue_wait_s").observe(wait)
                     # dispatch_rtt_s starts here: handoff to the worker.
                     self._tele_dispatched[job_id] = time.monotonic()
+                if _lineage.enabled():
+                    pl = self._payloads[job_id]
+                    _lineage.record(
+                        "dispatched", self._job_genome.get(job_id),
+                        job=job_id, worker=w.worker_id,
+                        rung=(pl.get("fidelity") or {}).get("rung", 0),
+                        session=sid if sid != DEFAULT_SESSION else None)
                 if ops:
                     # Same clock start as dispatch_rtt_s: the watchdog
                     # measures handoff → now against its rolling threshold.
@@ -1076,6 +1090,11 @@ class JobBroker:
                 sess = self._registry.peek(sid)
                 if sess is not None:
                     sess.requeued += 1
+                if _lineage.enabled():
+                    _lineage.record(
+                        "requeued", self._job_genome.get(job_id),
+                        job=job_id, worker=w.worker_id, reason=reason,
+                        session=sid if sid != DEFAULT_SESSION else None)
                 if tele:
                     # Restart the clock: queue_wait/job measure time since
                     # the LAST enqueue, not since first submission.
@@ -1100,19 +1119,11 @@ class JobBroker:
         self._tele_dispatched.pop(job_id, None)
         sess = self._registry.peek(sid)
         if sess is not None:
-            sess.failed += 1
-            if gk is not None:
-                n = sess.poison_counts.get(gk, 0) + 1
-                sess.poison_counts[gk] = n
-                hit_threshold = force_quarantine or n >= self._registry.quarantine_after
-                if hit_threshold and gk not in sess.quarantine:
-                    sess.quarantine.add(gk)
-                    _get_registry().counter("session_quarantined_total",
-                                            session=sid).inc()
-                    _tele.record_event("genome_quarantined", {
-                        "session": sid, "genome": gk, "terminal_failures": n,
-                        "forced_by_crash": bool(force_quarantine),
-                    })
+            # Quarantine bookkeeping (poison counts, counter, telemetry
+            # event, lineage entry) lives with the session's books.
+            sess.record_terminal_failure(
+                gk, self._registry.quarantine_after,
+                force_quarantine=force_quarantine)
         if _tele.enabled():
             self._update_flow_gauges()
         if sess is not None and sess.remote:
@@ -1189,6 +1200,11 @@ class JobBroker:
             "job_id": job_id, "worker_id": holder.worker_id, "session": sid,
             "age_s": info.get("age_s"), "threshold_s": info.get("threshold_s"),
         })
+        if _lineage.enabled():
+            _lineage.record(
+                "requeued", self._job_genome.get(job_id),
+                job=job_id, worker=holder.worker_id, reason="straggler",
+                session=sid if sid != DEFAULT_SESSION else None)
         self._dispatch()
 
     def _ops_status(self) -> Dict[str, Any]:
@@ -1532,6 +1548,10 @@ class JobBroker:
             reported = msg.get("spans")
             if reported:
                 _tele.ingest(reported)
+                # Chip-hour attribution: the worker's per-genome `device`
+                # spans land in the cost ledger here, behind the same
+                # dedup check, so a duplicated frame never double-bills.
+                _lineage.observe_records(reported, worker=w.worker_id)
             self._update_flow_gauges()
         with self._cond:
             # Under _cond: reset_chips_seen()/chips_seen() run on the master
@@ -1567,8 +1587,14 @@ class JobBroker:
             self._fail_terminal(job_id, reason)
         else:
             logger.warning("job %s failed (%s); requeueing", job_id, reason)
-            self._sched.push(self._job_session.get(job_id, DEFAULT_SESSION), job_id)
+            sid = self._job_session.get(job_id, DEFAULT_SESSION)
+            self._sched.push(sid, job_id)
             self._tele_dispatched.pop(job_id, None)
+            if _lineage.enabled():
+                _lineage.record(
+                    "requeued", self._job_genome.get(job_id),
+                    job=job_id, worker=w.worker_id, reason="worker_fail",
+                    session=sid if sid != DEFAULT_SESSION else None)
             if _tele.enabled():
                 self._tele_enqueued[job_id] = time.monotonic()
             self._dispatch()
@@ -1604,6 +1630,11 @@ class JobBroker:
             sess = self._registry.peek(sid)
             if sess is not None:
                 sess.requeued += 1
+            if _lineage.enabled():
+                _lineage.record(
+                    "requeued", self._job_genome.get(job_id),
+                    job=job_id, worker=w.worker_id, reason="drain",
+                    session=sid if sid != DEFAULT_SESSION else None)
             if ops:
                 self._watchdog.job_removed(job_id)
             self._tele_dispatched.pop(job_id, None)

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Type
 from ..individuals import Individual
 from ..populations import Population
 from ..telemetry import health as _health
+from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .protocol import (
@@ -900,9 +901,28 @@ class GentunClient:
                     session = ok_jobs[0].get("session")
                     if session:
                         eval_attrs["session"] = str(session)
+                    t_eval0 = time.monotonic()
                     with _tele.attach(ok_jobs[0].get("trace")), _tele.capture() as captured:
                         with _tele.span("eval", eval_attrs):
                             pop.evaluate()
+                        # Search forensics (telemetry/lineage.py): when the
+                        # master stamped the forensics flag into the trace,
+                        # split the group's device time into one `device`
+                        # span per job — (session, genome, rung, worker)
+                        # attribution cells.  Emitted INSIDE the capture so
+                        # they ship home and the broker bills them (an
+                        # in-process ledger write here would double-count).
+                        if _lineage.wants_device_spans(ok_jobs[0].get("trace")):
+                            share = (time.monotonic() - t_eval0) / len(ok_jobs)
+                            for i, job in enumerate(ok_jobs):
+                                _lineage.emit_device(
+                                    share,
+                                    _lineage.genome_key(job["genes"]),
+                                    rung=(job.get("fidelity") or {}).get("rung", 0),
+                                    session=str(session) if session else None,
+                                    worker=self.worker_id,
+                                    job=job["job_id"],
+                                    start_monotonic=t_eval0 + i * share)
                     for rec in captured:
                         rec.setdefault("src", self.worker_id)
                 else:

@@ -58,6 +58,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
+from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from ..utils.fitness_store import (
@@ -565,6 +566,10 @@ class ServiceBackedCache(dict):
         if wk in hits:
             fitness = float(hits[wk])
             super().__setitem__(key, fitness)
+            # Lineage: a service hit means some OTHER search already paid
+            # for this training — identity here is the wire key (the
+            # fitness-cache content address), not genome_key.
+            _lineage.record("cache_hit", wk, source="service")
             return fitness
         return None
 

@@ -27,6 +27,7 @@ import numpy as np
 from ..individuals import Individual
 from ..populations import GridPopulation, Population
 from ..telemetry import health as _health
+from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .broker import GatherTimeout, JobBroker, JobFailed
@@ -324,6 +325,9 @@ class DistributedPopulation(Population):
         payloads: Dict[str, Dict[str, Any]] = {}
         ids: List[str] = []
         ctx = _tele.current_context() if _tele.enabled() else None
+        # Forensics opt-in rides the trace context (lineage.py): workers
+        # only emit per-job device spans when the master is accounting.
+        ctx = _lineage.forensic_context(ctx)
         for ind in individuals:
             job_id = JobBroker.new_job_id()
             payload: Dict[str, Any] = {
@@ -492,7 +496,7 @@ class DistributedPopulation(Population):
             # live master-side span context (normally the generation's
             # `evaluate` span) rides every job payload; workers re-attach
             # it so their train/eval spans join this trace.
-            ctx = _tele.current_context()
+            ctx = _lineage.forensic_context(_tele.current_context())
             if ctx is not None:
                 for payload in payloads.values():
                     payload["trace"] = ctx
@@ -597,7 +601,7 @@ class DistributedPopulation(Population):
                 "population_dedup_collapsed_total", species=self.species.__name__,
             ).inc(len(pending) - len(payloads))
         if tele:
-            ctx = _tele.current_context()
+            ctx = _lineage.forensic_context(_tele.current_context())
             if ctx is not None:
                 for payload in payloads.values():
                     payload["trace"] = ctx

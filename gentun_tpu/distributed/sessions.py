@@ -39,8 +39,6 @@ for tenant B's).
 
 from __future__ import annotations
 
-import hashlib
-import json
 import socket
 import threading
 import time
@@ -48,6 +46,9 @@ import uuid
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
 
+from ..telemetry import lineage as _lineage
+from ..telemetry import spans as _tele
+from ..telemetry.registry import get_registry as _get_registry
 from .protocol import MAX_MESSAGE_BYTES, AuthError, decode, encode
 
 __all__ = [
@@ -73,19 +74,11 @@ class UnknownSessionError(ValueError):
     mis-addressed job would strand its ``gather``/``wait_any`` forever."""
 
 
-def genome_key(genes: Any) -> str:
-    """Content address for a genome within the quarantine table.
-
-    64-bit blake2b over the canonical (sorted-key) JSON of the genes —
-    the same hash family and width as ``utils/fitness_store.key_digest``.
-    Genes that don't survive JSON fall back to ``repr`` so a quarantine
-    verdict still sticks to the exact value that crashed the worker.
-    """
-    try:
-        blob = json.dumps(genes, sort_keys=True, separators=(",", ":"))
-    except (TypeError, ValueError):
-        blob = repr(genes)
-    return hashlib.blake2b(blob.encode("utf-8"), digest_size=8).hexdigest()
+# Content address for a genome — canonical implementation now lives with
+# the forensics plane (the lineage ledger keys on the same identity the
+# quarantine table always used); re-exported here for every existing
+# import site.
+genome_key = _lineage.genome_key
 
 
 class SearchSession:
@@ -123,6 +116,41 @@ class SearchSession:
         self.quarantine: Set[str] = set()
         self.owner = None
         self.undelivered: Deque[Dict[str, Any]] = deque(maxlen=10_000)
+
+    def record_terminal_failure(self, gk: Optional[str],
+                                quarantine_after: int,
+                                force_quarantine: bool = False) -> bool:
+        """Book one terminal evaluation failure against this session.
+
+        Bumps ``failed`` and the genome's poison count; at
+        ``quarantine_after`` failures (or immediately under
+        ``force_quarantine`` — the crash-isolation path) the genome is
+        quarantined for THIS session, surfacing as the
+        ``session_quarantined_total`` counter, a ``genome_quarantined``
+        telemetry event, and a ``quarantined`` lineage ledger entry.
+        Returns whether the genome was NEWLY quarantined.  Called from the
+        broker loop thread (the same single-writer discipline as the rest
+        of the books).
+        """
+        self.failed += 1
+        if gk is None:
+            return False
+        n = self.poison_counts.get(gk, 0) + 1
+        self.poison_counts[gk] = n
+        hit = force_quarantine or n >= quarantine_after
+        if not hit or gk in self.quarantine:
+            return False
+        self.quarantine.add(gk)
+        _get_registry().counter("session_quarantined_total",
+                                session=self.session_id).inc()
+        _tele.record_event("genome_quarantined", {
+            "session": self.session_id, "genome": gk, "terminal_failures": n,
+            "forced_by_crash": bool(force_quarantine),
+        })
+        _lineage.record("quarantined", gk, session=self.session_id,
+                        terminal_failures=n,
+                        forced_by_crash=bool(force_quarantine))
+        return True
 
     def snapshot(self, in_flight: int = 0, queued: int = 0) -> Dict[str, Any]:
         return {

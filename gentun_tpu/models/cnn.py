@@ -65,6 +65,7 @@ import optax
 from ..ops.dag import stack_genome_masks
 from ..parallel.mesh import auto_mesh, mesh_axis_sizes, pad_population, pop_bucket, shard_cv_args
 from ..parallel.multihost import fetch, place, place_tree
+from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..utils.jax_state import mark_backend_used
 from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
@@ -667,7 +668,13 @@ def _warm_start_overlay(params, hashes):
             if bl.shape == host[j].shape[2:] and bl.dtype == host[j].dtype:
                 host[j][:, i] = bl
                 hit = True
-        warmed += int(hit)
+        if hit:
+            warmed += 1
+            # Lineage: identity here is the weight bank's CONTENT key (the
+            # genome-mask hash pair), not telemetry.lineage.genome_key — the
+            # bank never sees genes, only stacked masks.
+            _lineage.record(
+                "warm_started", "bank:%x:%x" % key, slot=i)
     if host is None:
         return params, 0
     return jax.tree.unflatten(treedef, [jnp.asarray(h) for h in host]), warmed

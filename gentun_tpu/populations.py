@@ -19,11 +19,13 @@ individuals/hour/chip lever).  The per-individual lazy path
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Type
 
 import numpy as np
 
 from .individuals import Individual
+from .telemetry import lineage as _lineage
 from .telemetry import spans as _tele
 from .telemetry.registry import get_registry as _get_registry
 
@@ -96,6 +98,14 @@ class Population:
             self.individuals: List[Individual] = list(individual_list)
         elif size is not None:
             self.individuals = [self.spawn() for _ in range(size)]
+            if _lineage.enabled():
+                # Random init is where every founder lineage starts: record
+                # the births here (not in spawn(), which the ladder and
+                # promotion probes also call for genome *copies*).
+                for ind in self.individuals:
+                    _lineage.record(
+                        "born", _lineage.genome_key(ind.get_genes()),
+                        op="spawn")
         else:
             raise ValueError("provide either `size` or `individual_list`")
 
@@ -256,6 +266,13 @@ class Population:
             # OR the sequential fallback — so every species (a worker-side
             # OneMax as much as a vmapped CNN) reports training time.
             # cnn.py's finer compile/train/eval spans nest inside this one.
+            # Forensics (docs/OBSERVABILITY.md "Search forensics"): local
+            # evaluation attributes its own device-seconds — an even share
+            # of the group's train wall time per representative.  Skipped
+            # inside a worker capture (the worker's own per-job device
+            # spans are the ones the broker bills — never both).
+            lin = _lineage.enabled() and not _tele.capturing()
+            t_train0 = time.monotonic()
             if tele:
                 with _tele.span("train", {"individuals": len(batch),
                                           "species": self.species.__name__}) as sp:
@@ -263,6 +280,14 @@ class Population:
                     sp.set(batched=batched_ok)
             else:
                 batched_ok = self._train_group(batch, reps)
+            if lin and reps:
+                share = (time.monotonic() - t_train0) / len(reps)
+                for i, ind in enumerate(reps):
+                    _lineage.emit_device(
+                        share, _lineage.genome_key(ind.get_genes()),
+                        rung=(getattr(ind, "_fidelity_tag", None)
+                              or {}).get("rung", 0),
+                        start_monotonic=t_train0 + i * share)
             if batched_ok:
                 for ind in spec:
                     key = self._safe_cache_key(ind)

@@ -19,6 +19,15 @@ Plus the live ops plane (OBSERVABILITY.md "Live ops plane"):
 - :mod:`.ops_server` — ``/metrics`` + ``/healthz`` + ``/statusz`` +
   ``/debugz/flight`` on a stdlib HTTP server in a daemon thread.
 
+And the search-forensics plane (OBSERVABILITY.md "Search forensics"):
+
+- :mod:`.lineage` — per-genome lineage ledger (born/dispatched/completed/
+  promoted/evicted/…) and the chip-hour :class:`~.lineage.CostLedger`
+  attributing device-seconds to ``(session, genome, rung, worker)``.
+- :mod:`.traceviz` — offline converter from a run's ``telemetry.jsonl``
+  to Chrome ``trace_event`` JSON loadable in Perfetto, with flow events
+  linking dispatch→evaluate→result across processes.
+
 Quick start::
 
     from gentun_tpu import telemetry
@@ -29,6 +38,7 @@ Quick start::
 from .export import RunTelemetry, active_run, end_run, start_run
 from .flight import FlightRecorder
 from .health import StallWatchdog
+from .lineage import CostLedger, genome_key, get_ledger
 from .ops_server import OpsServer, active_ops_server, start_ops_server, stop_ops_server
 from .registry import (
     DEFAULT_BUCKETS,
@@ -74,6 +84,9 @@ __all__ = [
     "ingest",
     "StallWatchdog",
     "FlightRecorder",
+    "CostLedger",
+    "genome_key",
+    "get_ledger",
     "OpsServer",
     "start_ops_server",
     "stop_ops_server",

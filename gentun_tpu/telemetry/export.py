@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import lineage as _lineage
 from . import spans as _spans
 from .registry import get_registry
 
@@ -160,7 +161,7 @@ class RunTelemetry:
                 "p99": _percentile(vals, 0.99),
             }
         snap = self._registry.snapshot()
-        return {
+        out = {
             "label": self.label,
             "wall_s": time.monotonic() - self._t0,
             "n_spans": n_spans,
@@ -169,6 +170,23 @@ class RunTelemetry:
             "counters": snap["counters"],
             "gauges": snap["gauges"],
         }
+        if _lineage.enabled():
+            # Chip-hour cost table (docs/OBSERVABILITY.md "Search
+            # forensics"): measured device-seconds per rung/session/worker
+            # from the forensics ledger — the run's cost accounting,
+            # derived from per-genome device spans rather than estimated
+            # from analytic schedule costs.
+            ledger = _lineage.get_ledger()
+            out["cost"] = {
+                "device_s_total": ledger.total(),
+                "cost_s_by_rung": {str(k): v for k, v in
+                                   sorted(ledger.by_rung().items())},
+                "cost_s_by_session": {k: v for k, v in
+                                      sorted(ledger.by_session().items())},
+                "cost_s_by_worker": {k: v for k, v in
+                                     sorted(ledger.by_worker().items())},
+            }
+        return out
 
 
 # -- module-level active run (what production hook sites look up) ----------

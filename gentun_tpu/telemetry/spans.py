@@ -43,6 +43,8 @@ __all__ = [
     "current_context",
     "attach",
     "capture",
+    "capturing",
+    "emit_record",
     "set_run_sink",
     "has_run_sink",
     "set_flight_sink",
@@ -134,10 +136,22 @@ def _emit(rec: Dict[str, Any], dur_kind: Optional[Tuple[float, str]] = None) -> 
         cap.append(rec)
         return
     if dur_kind is not None:
-        get_registry().histogram("span_seconds", kind=dur_kind[1]).observe(dur_kind[0])
+        _observe_span_seconds(dur_kind[1], dur_kind[0], rec)
     sink = _run_sink
     if sink is not None:
         sink.record(rec)
+
+
+def _observe_span_seconds(kind: str, dur: float, rec: Dict[str, Any]) -> None:
+    """Observe a span duration, adding a ``session`` label only when the
+    span carries one (multi-tenant runs) — single-tenant series keep their
+    pre-session label set, same pattern as the straggler counters."""
+    attrs = rec.get("attrs")
+    sess = attrs.get("session") if attrs else None
+    if sess is None:
+        get_registry().histogram("span_seconds", kind=kind).observe(dur)
+    else:
+        get_registry().histogram("span_seconds", kind=kind, session=str(sess)).observe(dur)
 
 
 class _NoopSpan:
@@ -278,6 +292,23 @@ def current_context() -> Optional[Dict[str, str]]:
     return {"trace_id": ctx[0], "span_id": ctx[1]}
 
 
+def capturing() -> bool:
+    """Whether a :class:`capture` sink is active in this context — i.e.
+    records emitted here will be shipped to (and accounted by) a remote
+    master rather than landing locally.  The lineage cost ledger uses
+    this to avoid double-counting in-process workers."""
+    return _CAPTURE.get() is not None
+
+
+def emit_record(rec: Dict[str, Any]) -> None:
+    """Route an externally built record (a lineage ledger entry) through
+    the standard sinks — flight ring, innermost capture list, else the
+    run sink — with no histogram side effects.  Callers guard on
+    :func:`enabled`; this is the raw-routing twin of :func:`record_event`
+    for records whose schema the caller owns."""
+    _emit(rec)
+
+
 class attach:
     """Adopt a remote trace context so local spans parent under it.
 
@@ -331,12 +362,11 @@ def ingest(records) -> None:
     master's histograms cover worker time too."""
     if not _ENABLED or not records:
         return
-    reg = get_registry()
     for rec in records:
         if not isinstance(rec, dict):
             continue
         if rec.get("type") == "span" and "dur_s" in rec and "kind" in rec:
-            reg.histogram("span_seconds", kind=rec["kind"]).observe(rec["dur_s"])
+            _observe_span_seconds(rec["kind"], rec["dur_s"], rec)
         _emit(rec)
 
 

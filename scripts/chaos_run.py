@@ -25,6 +25,12 @@ self-heal to 200 when the stalled result lands.  It runs separately from
 the composed plan above because the composed schedule is count-based and
 timing-sensitive: observation load must not decide which faults fire.
 
+A forensics act (``run_forensics_act``) replays the poison-genome story
+under the search-forensics plane (lineage ledger ON): the injected
+evaluation failures must surface as ``requeued`` and ``quarantined``
+lineage events in the run artifact, keyed to the poison genome — chaos
+is not just survived, it is narrated.
+
 CPU-only, a few seconds: `python scripts/chaos_run.py` writes
 ``scripts/chaos_run.json``.  The plan is serialized into the artifact, so
 a recorded run can be replayed exactly.
@@ -52,9 +58,10 @@ from gentun_tpu.distributed import (  # noqa: E402
     FaultPlan,
     FaultSpec,
     GentunClient,
+    JobBroker,
     MasterKilled,
 )
-from gentun_tpu.telemetry import RunTelemetry  # noqa: E402
+from gentun_tpu.telemetry import RunTelemetry, lineage  # noqa: E402
 from gentun_tpu.telemetry.ops_server import start_ops_server, stop_ops_server  # noqa: E402
 from gentun_tpu.utils import Checkpointer  # noqa: E402
 
@@ -612,12 +619,111 @@ def run_cache_chaos() -> dict:
     }
 
 
+def run_forensics_act() -> dict:
+    """Chaos under the search-forensics plane: with the lineage ledger ON,
+    the fault paths must narrate themselves in the run artifact.  A
+    single-worker broker with ``max_attempts=2, quarantine_after=1`` gets
+    one poison job: the first injected evaluation failure requeues it (a
+    ``requeued`` lineage event, reason ``worker_fail``), the second fails
+    it terminally and quarantines its genome in the session (a
+    ``quarantined`` lineage event).  Asserts both surface in the lineage
+    ledger keyed to the poison genome, that the quarantined genome's
+    resubmission is rejected without dispatch, and that a clean genome
+    still evaluates on the same worker afterwards."""
+    plan = FaultPlan([
+        FaultSpec(hook="worker_pre_eval", kind="fail_eval", at=0, times=2),
+    ], seed=2026)
+    inj = FaultInjector(plan)
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_forensics_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-forensics").install()
+    lineage.reset_ledger()
+    lineage.enable()
+    broker = JobBroker(port=0, max_attempts=2, quarantine_after=1,
+                       heartbeat_timeout=30.0).start()
+    t0 = time.monotonic()
+    stops = []
+    try:
+        _, port = broker.address
+        stops.append(_worker(port, injector=inj, worker_id="fz-chaos-w0"))
+        sid = broker.open_session("fz-chaos")
+        pool = Population(OneMax, *DATA, size=2, seed=13)
+        poison, clean = (ind.get_genes() for ind in pool)
+        gk = lineage.genome_key(poison)
+
+        broker.submit({"fz-poison": {"genes": poison}}, session=sid)
+        _, fails = broker.wait_any(["fz-poison"], timeout=30)
+        assert "fz-poison" in fails, "poison job unexpectedly succeeded"
+        # The quarantined genome bounces at the gate — never dispatched.
+        broker.submit({"fz-again": {"genes": poison}}, session=sid)
+        _, fails2 = broker.wait_any(["fz-again"], timeout=15)
+        assert "quarantined" in fails2["fz-again"]
+        # The worker is fine (the genome was "poison", not the process):
+        # a clean genome still evaluates normally.
+        broker.submit({"fz-clean": {"genes": clean}}, session=sid)
+        results, fails3 = broker.wait_any(["fz-clean"], timeout=30)
+        assert fails3 == {}, f"clean job failed: {fails3}"
+        assert results["fz-clean"] == float(
+            sum(sum(g) for g in clean.values()))
+        wall = time.monotonic() - t0
+        stats = broker.session_stats()[sid]
+    finally:
+        for s in stops:
+            s.set()
+        tele_summary = run_tele.close()
+        lineage.disable()
+        broker.stop()
+
+    assert list(inj.fired), "the eval-failure faults never fired"
+    assert stats["quarantined"] == 1 and stats["rejected"] == 1
+
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    lin = [r for r in tele_lines if r.get("type") == "lineage"]
+    by_event = {}
+    for e in lin:
+        by_event.setdefault(e["event"], []).append(e)
+    requeued = [e for e in by_event.get("requeued", [])
+                if e.get("genome") == gk and e.get("reason") == "worker_fail"]
+    assert requeued, (
+        f"injected eval failure never surfaced as a requeued lineage "
+        f"event: {by_event.get('requeued')}")
+    quarantined = [e for e in by_event.get("quarantined", [])
+                   if e.get("genome") == gk and e.get("session") == sid]
+    assert quarantined, (
+        f"quarantine never surfaced as a lineage event: "
+        f"{by_event.get('quarantined')}")
+    assert by_event.get("dispatched"), "no dispatched lineage events"
+
+    return {
+        "workers": 1,
+        "fault_plan": plan.to_dict(),
+        "faults_fired": list(inj.fired),
+        "session": sid,
+        "poison_genome": gk,
+        "session_stats": {k: stats[k] for k in
+                          ("submitted", "failed", "quarantined", "rejected")},
+        "lineage_events": {k: len(v) for k, v in sorted(by_event.items())},
+        "requeued_events": [{k: e.get(k) for k in
+                             ("genome", "job", "worker", "reason", "session")}
+                            for e in requeued],
+        "quarantined_events": [{k: e.get(k) for k in
+                                ("genome", "session", "terminal_failures")}
+                               for e in quarantined],
+        "n_spans": tele_summary["n_spans"],
+        "wall_s": round(wall, 3),
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
     out["async_smoke"] = run_async_smoke()
     out["ladder"] = run_ladder_act()
     out["cache_service"] = run_cache_chaos()
+    out["forensics"] = run_forensics_act()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

@@ -44,6 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from gentun_tpu import AsyncEvolution, Individual, Population, genetic_cnn_genome  # noqa: E402
 from gentun_tpu.distributed import FaultInjector, FaultPlan, FaultSpec  # noqa: E402
 from gentun_tpu.distributed.faults import MasterKilled  # noqa: E402
+from gentun_tpu.telemetry import RunTelemetry, lineage, traceviz  # noqa: E402
 from gentun_tpu.utils import Checkpointer  # noqa: E402
 
 NODES = (4, 4)  # 12 genome bits → fitness in [0, 12]
@@ -115,23 +116,28 @@ def _pop(**kw):
                       maximize=True, additional_parameters={"nodes": NODES}, **kw)
 
 
-def _curve(history, ladder):
-    """(cum chip-seconds, best full-fidelity fitness so far) per completion.
+def _lineage_curve(completed_events, ladder):
+    """(cum chip-seconds, best full-fidelity fitness so far) per completion,
+    sourced from the forensics plane's ``completed`` lineage events
+    (telemetry/lineage.py) — the same event-sourced ledger every search
+    artifact carries, instead of a study-private replay of engine history.
 
-    Cached completions bill zero chip-seconds (the fleet never retrained);
-    proxy-rung fitnesses never advance the best — only measurements at the
-    full schedule count, so both modes are scored on the same scale.
+    Cached completions bill zero chip-seconds (the fleet never retrained;
+    the ledger marks them ``cached``); proxy-rung fitnesses never advance
+    the best — only measurements at the full schedule count, so both modes
+    are scored on the same scale.
     """
     top = len(ladder) - 1 if ladder else None
     spent, best, points = 0.0, None, []
-    for h in history:
-        rung = h.get("rung", top)
+    for e in completed_events:
+        rung = e.get("rung", 0)
         knobs = ladder[rung] if ladder else FULL
-        if not h.get("cached") and h.get("fitness") is not None:
+        if not e.get("cached"):
             spent += _cost(knobs)
-        if h.get("fitness") is not None and (top is None or rung == top):
-            if best is None or h["fitness"] > best:
-                best = h["fitness"]
+        if top is None or rung == top:
+            f = e.get("fitness")
+            if f is not None and (best is None or f > best):
+                best = f
         points.append([spent, best])
     return points
 
@@ -156,16 +162,47 @@ def _run(ladder=None, checkpointer=None, injector=None, budget=None):
     return eng, best
 
 
+def _run_forensic(ladder=None):
+    """One curve run under the forensics plane: the lineage ledger supplies
+    the ``completed`` event stream the chip-second curve is built from, and
+    ``RunTelemetry.summary()['cost']`` supplies the MEASURED per-rung
+    device-second table (the analytic knob costs' measured twin)."""
+    import tempfile
+
+    lineage.reset_ledger()
+    lineage.enable()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "telemetry.jsonl")
+            with RunTelemetry(path, label="fidelity-study") as run:
+                eng, best = _run(ladder=ladder)
+            summary = run.summary()
+            completed = [r for r in traceviz.load_jsonl(path)
+                         if r.get("type") == "lineage"
+                         and r.get("event") == "completed"]
+    finally:
+        lineage.disable()
+    return eng, best, completed, summary.get("cost", {})
+
+
 def _history_sig(eng):
     return [(h["fitness"], h.get("rung")) for h in eng.history]
 
 
 def main() -> int:
-    # -- the two chip-hour curves ---------------------------------------
-    full_eng, full_best = _run(ladder=None)
-    ladder_eng, ladder_best = _run(ladder=LADDER)
-    full_curve = _curve(full_eng.history, None)
-    ladder_curve = _curve(ladder_eng.history, LADDER)
+    # -- the two chip-hour curves (lineage-ledger accounting) -----------
+    full_eng, full_best, full_done, full_cost = _run_forensic(ladder=None)
+    ladder_eng, ladder_best, ladder_done, ladder_cost = _run_forensic(ladder=LADDER)
+    full_curve = _lineage_curve(full_done, None)
+    ladder_curve = _lineage_curve(ladder_done, LADDER)
+
+    # The ledger must be a faithful account of what the engine did: one
+    # `completed` event per successful history entry, same fitness stream.
+    lineage_faithful = (
+        [e["fitness"] for e in ladder_done]
+        == [h["fitness"] for h in ladder_eng.history if not h.get("failed")]
+        and len(full_done) == len(full_eng.history)
+    )
 
     target = max(b for _, b in full_curve if b is not None)
     t_full = _time_to(full_curve, target)
@@ -216,6 +253,7 @@ def main() -> int:
             "best_fitness": target,
             "chip_seconds_total": full_curve[-1][0],
             "chip_seconds_to_best": t_full,
+            "measured_device_s_by_rung": full_cost.get("cost_s_by_rung"),
             "curve": full_curve,
         },
         "ladder": {
@@ -225,9 +263,11 @@ def main() -> int:
             "chip_seconds_to_full_best": t_ladder,
             "promotions": sum(1 for h in ladder_eng.history if h.get("promotion")),
             "rung_completions": [len(v) for v in ladder_eng._rung_completions],
+            "measured_device_s_by_rung": ladder_cost.get("cost_s_by_rung"),
             "curve": ladder_curve,
         },
         "gates": {
+            "lineage_accounting_faithful": bool(lineage_faithful),
             "reached_full_best": t_ladder is not None,
             "chip_hour_speedup": speedup,
             "speedup_at_least_5x": bool(speedup and speedup >= 5.0),
@@ -255,8 +295,9 @@ def main() -> int:
           f"(boundary {g['kill_boundary']}), resume identical "
           f"{g['kill_resume_bit_identical']}")
     print(f"wrote {path}")
-    ok = all([g["reached_full_best"], g["speedup_at_least_5x"],
-              g["seeded_determinism"], g["promotion_was_in_flight_at_kill"],
+    ok = all([g["lineage_accounting_faithful"], g["reached_full_best"],
+              g["speedup_at_least_5x"], g["seeded_determinism"],
+              g["promotion_was_in_flight_at_kill"],
               g["kill_resume_bit_identical"]])
     return 0 if ok else 1
 

@@ -31,6 +31,7 @@ _BOLD, _DIM, _RED, _GREEN, _YELLOW, _RESET = (
 #: Counters worth a line on the dashboard, in display order (the full
 #: registry instrument set — see docs/OBSERVABILITY.md metric catalog).
 _HEADLINE_COUNTERS = (
+    "device_seconds_total",
     "stragglers_detected_total",
     "stragglers_requeued_total",
     "population_cache_hits_total",
@@ -236,6 +237,28 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                      f"hit-rate {'-' if rate is None else f'{rate:.1%}'}  "
                      f"pending-publish {cache.get('pending_publish')}  "
                      f"local {cache.get('local_entries', '-')}")
+
+    # Chip-hour cost panel (search forensics, docs/OBSERVABILITY.md): the
+    # "cost" status provider exists only while the lineage plane is on —
+    # measured device-seconds from the cost ledger, attributed to
+    # (session, genome, rung, worker), rolled up here per axis.
+    cost = statusz.get("cost") or (worker or {}).get("cost")
+    if cost:
+        total_s = cost.get("device_s_total", 0) or 0
+        rungs = "  ".join(f"r{r}={s:.1f}s" for r, s in
+                          sorted((cost.get("by_rung") or {}).items()))
+        lines.append(f"{B}cost{X}  device {total_s:.1f}s "
+                     f"({total_s / 3600:.4f} chip-h)  "
+                     f"genomes {cost.get('genomes', '-')}"
+                     + (f"  {D}{rungs}{X}" if rungs else ""))
+        for axis in ("by_session", "by_worker"):
+            cells = cost.get(axis) or {}
+            if cells:
+                top = sorted(cells.items(), key=lambda kv: -kv[1])[:4]
+                lines.append(f"  {D}{axis[3:]}:{X}  " + "  ".join(
+                    f"{k}={s:.1f}s" for k, s in top)
+                    + (f"  {D}(+{len(cells) - 4} more){X}"
+                       if len(cells) > 4 else ""))
 
     headline = [(n, totals[n]) for n in _HEADLINE_COUNTERS if n in totals]
     if headline:

@@ -1,0 +1,466 @@
+"""Search forensics: lineage ledger, chip-hour cost accounting, traceviz.
+
+Covers the forensics plane (docs/OBSERVABILITY.md "Search forensics"):
+
+- ``telemetry/lineage.py`` unit behaviour — the one-bool-read disabled
+  path, genome keys, the cost ledger's attribution cells and rollups, the
+  exactly-once device-span billing split (capture → broker vs local),
+  and the ``fz`` wire advertisement;
+- conditional ``{session}`` labels on ``span_seconds``;
+- ``telemetry/traceviz.py`` — trace_event JSON schema, non-negative
+  monotonic ts/dur, stable pid/tid mapping, flow ids drawn from span ids;
+- an end-to-end 2-worker fidelity-ladder search whose artifact contains
+  every worker's device spans, a reconstructable winner ancestry, and a
+  ≥99% chip-second attribution ratio — plus bit-identity with forensics
+  off.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import AsyncEvolution, Individual, Population, genetic_cnn_genome
+from gentun_tpu.telemetry import RunTelemetry, lineage
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry import traceviz
+from gentun_tpu.telemetry.health import status_snapshot
+from gentun_tpu.telemetry.registry import get_registry
+
+
+@pytest.fixture(autouse=True)
+def _pristine_forensics():
+    """Lineage/telemetry state is process-global; start and end clean."""
+    lineage.disable()
+    lineage.reset_ledger()
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    lineage.disable()
+    lineage.reset_ledger()
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def record(self, rec):
+        self.records.append(rec)
+
+
+def _sinked():
+    sink = _ListSink()
+    spans_mod.enable()
+    spans_mod.set_run_sink(sink)
+    return sink
+
+
+# ---------------------------------------------------------------------------
+# lineage unit behaviour
+# ---------------------------------------------------------------------------
+
+
+class TestGenomeKey:
+    def test_deterministic_and_order_insensitive(self):
+        a = lineage.genome_key({"s1": [1, 0, 1], "s2": [0, 0, 0]})
+        b = lineage.genome_key({"s2": [0, 0, 0], "s1": [1, 0, 1]})
+        assert a == b
+        assert len(a) == 16  # blake2b digest_size=8, hex
+
+    def test_distinct_genes_distinct_keys(self):
+        assert lineage.genome_key({"s1": [1]}) != lineage.genome_key({"s1": [0]})
+
+    def test_unjsonable_genes_fall_back_to_repr(self):
+        key = lineage.genome_key({"s1": object()})
+        assert isinstance(key, str) and len(key) == 16
+
+
+class TestRecord:
+    def test_disabled_emits_nothing(self):
+        sink = _sinked()
+        lineage.record("born", "abcd", op="spawn")
+        assert sink.records == []
+
+    def test_enabled_emits_through_run_sink(self):
+        sink = _sinked()
+        lineage.enable()
+        lineage.record("born", "abcd", parents=["p1", "p2"], op="reproduce")
+        recs = [r for r in sink.records if r.get("type") == "lineage"]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["event"] == "born" and rec["genome"] == "abcd"
+        assert rec["parents"] == ["p1", "p2"] and rec["op"] == "reproduce"
+        assert "t_wall" in rec and "pid" in rec
+
+    def test_none_fields_dropped(self):
+        sink = _sinked()
+        lineage.enable()
+        lineage.record("dispatched", "abcd", worker="w0", session=None)
+        rec = [r for r in sink.records if r.get("type") == "lineage"][0]
+        assert "session" not in rec and rec["worker"] == "w0"
+
+    def test_enable_registers_cost_status_provider(self):
+        lineage.enable()
+        lineage.get_ledger().add(1.5, rung=0)
+        assert status_snapshot()["cost"]["device_s_total"] == pytest.approx(1.5)
+        lineage.disable()
+        assert "cost" not in status_snapshot()
+
+
+class TestCostLedger:
+    def test_cells_and_rollups(self):
+        led = lineage.CostLedger()
+        led.add(1.0, session="s", genome="g1", rung=0, worker="w0")
+        led.add(2.0, session="s", genome="g1", rung=1, worker="w1")
+        led.add(4.0, genome="g2")  # default session/rung/worker
+        assert led.total() == pytest.approx(7.0)
+        assert led.by_rung() == {0: pytest.approx(5.0), 1: pytest.approx(2.0)}
+        assert led.by_session() == {"s": pytest.approx(3.0),
+                                    "default": pytest.approx(4.0)}
+        assert led.by_worker() == {"w0": pytest.approx(1.0),
+                                   "w1": pytest.approx(2.0),
+                                   "local": pytest.approx(4.0)}
+        assert led.by_genome()["g1"] == pytest.approx(3.0)
+        rows = led.cells()
+        assert {r["genome"] for r in rows} == {"g1", "g2"}
+        snap = led.snapshot()
+        assert snap["genomes"] == 2
+        assert snap["by_rung"]["0"] == pytest.approx(5.0)
+
+    def test_add_same_cell_accumulates(self):
+        led = lineage.CostLedger()
+        led.add(1.0, genome="g", rung=2, worker="w")
+        led.add(0.5, genome="g", rung=2, worker="w")
+        assert led.cells() == [{"session": "default", "genome": "g",
+                                "rung": 2, "worker": "w",
+                                "device_s": pytest.approx(1.5)}]
+
+    def test_device_seconds_counter(self):
+        spans_mod.enable()
+        lineage.get_ledger().add(2.0, rung=1)
+        snap = get_registry().snapshot()
+        row = [c for c in snap["counters"]
+               if c["name"] == "device_seconds_total"]
+        assert row and row[0]["labels"] == {"rung": "1"}
+        assert row[0]["value"] == pytest.approx(2.0)
+
+
+class TestDeviceSpanBilling:
+    def test_local_emit_bills_ledger_and_emits_span(self):
+        sink = _sinked()
+        lineage.enable()
+        lineage.emit_device(0.25, "g1", rung=1, worker="w9", session="s")
+        spans = [r for r in sink.records if r.get("kind") == "device"]
+        assert len(spans) == 1
+        assert spans[0]["attrs"]["genome"] == "g1"
+        assert lineage.get_ledger().total() == pytest.approx(0.25)
+
+    def test_captured_emit_ships_instead_of_billing(self):
+        _sinked()
+        lineage.enable()
+        with spans_mod.capture() as captured:
+            lineage.emit_device(0.25, "g1", rung=0, worker="w0")
+        # The span shipped into the capture list; the ledger was NOT
+        # charged — the broker bills the shipped record on ingest.
+        assert [r["kind"] for r in captured] == ["device"]
+        assert lineage.get_ledger().total() == 0.0
+        lineage.observe_records(captured, worker="w0")
+        assert lineage.get_ledger().total() == pytest.approx(0.25)
+        assert lineage.get_ledger().by_worker() == {"w0": pytest.approx(0.25)}
+
+    def test_observe_records_disabled_is_noop(self):
+        lineage.observe_records(
+            [{"type": "span", "kind": "device", "dur_s": 1.0}])
+        assert lineage.get_ledger().total() == 0.0
+
+    def test_observe_records_skips_non_device(self):
+        lineage.enable()
+        lineage.observe_records([
+            {"type": "span", "kind": "eval", "dur_s": 5.0},
+            {"type": "lineage", "event": "born"},
+            "garbage",
+        ])
+        assert lineage.get_ledger().total() == 0.0
+
+
+class TestWireAdvertisement:
+    def test_context_unchanged_when_disabled(self):
+        ctx = {"trace_id": "t", "span_id": "s"}
+        assert lineage.forensic_context(ctx) is ctx
+        assert lineage.forensic_context(None) is None
+
+    def test_context_copied_and_stamped_when_enabled(self):
+        lineage.enable()
+        ctx = {"trace_id": "t", "span_id": "s"}
+        out = lineage.forensic_context(ctx)
+        assert out is not ctx and out["fz"] == 1
+        assert "fz" not in ctx  # the caller's dict is never mutated
+        assert lineage.forensic_context(None) is None
+
+    def test_wants_device_spans(self):
+        assert not lineage.wants_device_spans(None)
+        assert not lineage.wants_device_spans({"trace_id": "t"})
+        assert lineage.wants_device_spans({"trace_id": "t", "fz": 1})
+
+
+class TestSessionSpanLabels:
+    def test_span_seconds_unlabelled_without_session(self):
+        spans_mod.enable()
+        spans_mod.record_span("eval", time.monotonic(), 0.1,
+                              attrs={"jobs": 3})
+        snap = get_registry().snapshot()
+        rows = [h for h in snap["histograms"] if h["name"] == "span_seconds"]
+        assert rows and all("session" not in h["labels"] for h in rows)
+
+    def test_span_seconds_session_label_when_present(self):
+        spans_mod.enable()
+        spans_mod.record_span("eval", time.monotonic(), 0.1,
+                              attrs={"session": "tenant1"})
+        spans_mod.record_span("eval", time.monotonic(), 0.2)
+        snap = get_registry().snapshot()
+        rows = {tuple(sorted(h["labels"].items()))
+                for h in snap["histograms"] if h["name"] == "span_seconds"}
+        assert (("kind", "eval"), ("session", "tenant1")) in rows
+        assert (("kind", "eval"),) in rows
+
+
+# ---------------------------------------------------------------------------
+# traceviz
+# ---------------------------------------------------------------------------
+
+
+def _sample_records():
+    """A miniature run: master span → broker queue_wait → worker eval +
+    device spans, one shared trace, plus lineage/event instants."""
+    t0 = 1000.0
+    return [
+        {"type": "run_start", "t_wall": t0, "pid": 1},
+        {"type": "span", "kind": "evaluate", "trace_id": "tr1",
+         "span_id": "sp1", "parent_id": None, "t_wall": t0 + 0.01,
+         "dur_s": 1.0, "pid": 10},
+        {"type": "span", "kind": "queue_wait", "trace_id": "tr1",
+         "span_id": "sp2", "parent_id": "sp1", "t_wall": t0 + 0.02,
+         "dur_s": 0.05, "pid": 10},
+        {"type": "span", "kind": "eval", "trace_id": "tr1",
+         "span_id": "sp3", "parent_id": "sp1", "t_wall": t0 + 0.08,
+         "dur_s": 0.5, "pid": 10, "src": "w1",
+         "attrs": {"session": "s"}},
+        {"type": "span", "kind": "device", "trace_id": "tr1",
+         "span_id": "sp4", "parent_id": "sp3", "t_wall": t0 + 0.09,
+         "dur_s": 0.25, "pid": 10, "src": "w1",
+         "attrs": {"genome": "g1", "rung": 1, "worker": "w1"}},
+        {"type": "span", "kind": "eval", "trace_id": "tr2",
+         "span_id": "sp5", "parent_id": None, "t_wall": t0 + 0.2,
+         "dur_s": 0.1, "pid": 10, "src": "w0"},
+        {"type": "lineage", "event": "born", "genome": "g1",
+         "t_wall": t0 + 0.005, "pid": 10, "op": "spawn"},
+        {"type": "event", "name": "fault", "t_wall": t0 + 0.3, "pid": 10},
+        {"type": "summary"},
+    ]
+
+
+class TestTraceviz:
+    def test_schema_valid_trace_event_json(self):
+        trace = traceviz.to_trace_events(_sample_records())
+        blob = json.dumps(trace)  # must be JSON-serializable as-is
+        back = json.loads(blob)
+        assert isinstance(back["traceEvents"], list)
+        for ev in back["traceEvents"]:
+            assert ev["ph"] in ("X", "M", "i", "s", "t", "f")
+            assert "pid" in ev and "name" in ev
+            if ev["ph"] == "X":
+                assert set(ev) >= {"ts", "dur", "tid", "cat", "args"}
+
+    def test_ts_and_dur_non_negative_and_normalized(self):
+        trace = traceviz.to_trace_events(_sample_records())
+        timed = [e for e in trace["traceEvents"] if "ts" in e]
+        assert timed and all(e["ts"] >= 0 for e in timed)
+        assert min(e["ts"] for e in timed) == 0  # earliest record at t=0
+        for e in trace["traceEvents"]:
+            if e["ph"] == "X":
+                assert e["dur"] >= 0
+
+    def test_stable_pid_mapping(self):
+        recs = _sample_records()
+        t1 = traceviz.to_trace_events(recs)
+        t2 = traceviz.to_trace_events(list(recs))
+        assert t1 == t2  # same input → byte-identical mapping
+        names = {e["args"]["name"]: e["pid"] for e in t1["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert names["master"] == 1
+        assert names["broker"] == 2
+        assert names["w0"] == 3 and names["w1"] == 4  # sorted worker order
+
+    def test_device_spans_on_per_rung_tracks(self):
+        trace = traceviz.to_trace_events(_sample_records())
+        dev = [e for e in trace["traceEvents"]
+               if e["ph"] == "X" and e["name"] == "device"]
+        assert dev and dev[0]["tid"] == traceviz.DEVICE_TID_BASE + 1
+
+    def test_flow_ids_are_span_ids(self):
+        trace = traceviz.to_trace_events(_sample_records())
+        flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "t", "f")]
+        assert flows, "cross-process trace produced no flow events"
+        span_ids = {e["args"]["span_id"] for e in trace["traceEvents"]
+                    if e["ph"] == "X" and "span_id" in e.get("args", {})}
+        assert {f["id"] for f in flows} <= span_ids
+        # tr1's 4 spans touch master+broker+w1 → s, t, t, f; tr2 is
+        # single-process → no flow.
+        assert sorted(f["ph"] for f in flows) == ["f", "s", "t", "t"]
+        finish = [f for f in flows if f["ph"] == "f"]
+        assert all(f.get("bp") == "e" for f in finish)
+
+    def test_convert_writes_loadable_file(self, tmp_path):
+        src = tmp_path / "t.jsonl"
+        with open(src, "w", encoding="utf-8") as fh:
+            for rec in _sample_records():
+                fh.write(json.dumps(rec) + "\n")
+            fh.write("not json\n")  # truncated tail must not break loading
+        out = tmp_path / "trace.json"
+        trace = traceviz.convert(str(src), str(out))
+        assert json.loads(out.read_text())["traceEvents"] == trace["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2-worker ladder search with forensics
+# ---------------------------------------------------------------------------
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (3, 3))))
+
+    def evaluate(self):
+        time.sleep(0.002)  # give device spans measurable width
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+LADDER = [{"kfold": 2, "epochs": (1,)}, {"kfold": 3, "epochs": (2,)}]
+
+
+@pytest.fixture(scope="module")
+def forensic_fleet_run(tmp_path_factory):
+    """ONE forensics-enabled 2-worker ladder search, shared by the E2E
+    asserts below (they only read the artifact)."""
+    from gentun_tpu.distributed import DistributedPopulation, GentunClient
+
+    path = str(tmp_path_factory.mktemp("fz") / "telemetry.jsonl")
+    lineage.disable()
+    lineage.reset_ledger()
+    get_registry().reset()
+    lineage.enable()
+    stops = []
+    try:
+        with RunTelemetry(path, label="forensics-e2e"):
+            with DistributedPopulation(
+                    OneMax, size=5, seed=3, port=0, maximize=True,
+                    job_timeout=60, session="fz") as pop:
+                _, port = pop.broker_address
+                for i in range(2):
+                    stop = threading.Event()
+                    client = GentunClient(
+                        OneMax, *DATA, host="127.0.0.1", port=port,
+                        capacity=1, worker_id=f"fz-w{i}",
+                        heartbeat_interval=0.2, reconnect_delay=0.05)
+                    threading.Thread(
+                        target=lambda c=client, s=stop: c.work(stop_event=s),
+                        daemon=True).start()
+                    stops.append(stop)
+                deadline = time.monotonic() + 10
+                while pop.broker.fleet_members() < 2:
+                    assert time.monotonic() < deadline, "workers never joined"
+                    time.sleep(0.01)
+                eng = AsyncEvolution(pop, tournament_size=3, seed=5,
+                                     fidelity_ladder=LADDER, eta=3,
+                                     job_timeout=60)
+                eng.run(max_evaluations=24)
+        snapshot = lineage.get_ledger().snapshot()
+    finally:
+        for s in stops:
+            s.set()
+        lineage.disable()
+        lineage.reset_ledger()
+        spans_mod.set_run_sink(None)
+        spans_mod.disable()
+    return {"path": path, "records": traceviz.load_jsonl(path),
+            "ledger": snapshot}
+
+
+class TestForensicsEndToEnd:
+    def test_every_worker_ships_device_spans(self, forensic_fleet_run):
+        dev = [r for r in forensic_fleet_run["records"]
+               if r.get("type") == "span" and r.get("kind") == "device"]
+        assert {r["attrs"]["worker"] for r in dev} == {"fz-w0", "fz-w1"}
+        assert all(r["attrs"]["session"] == "fz" for r in dev)
+        assert all("genome" in r["attrs"] and "job" in r["attrs"] for r in dev)
+
+    def test_lineage_ledger_covers_the_taxonomy(self, forensic_fleet_run):
+        events = {r["event"] for r in forensic_fleet_run["records"]
+                  if r.get("type") == "lineage"}
+        assert {"born", "dispatched", "completed"} <= events
+
+    def test_cost_attribution_at_least_99_percent(self, forensic_fleet_run):
+        recs = forensic_fleet_run["records"]
+        dev = sum(r["dur_s"] for r in recs
+                  if r.get("type") == "span" and r.get("kind") == "device")
+        ev = sum(r["dur_s"] for r in recs
+                 if r.get("type") == "span" and r.get("kind") == "eval")
+        assert ev > 0 and dev >= 0.99 * ev
+        # The broker billed the shipped spans into the master's ledger.
+        # (snapshot() rounds to µs precision)
+        led = forensic_fleet_run["ledger"]
+        assert led["device_s_total"] == pytest.approx(dev, abs=1e-5)
+        assert set(led["by_worker"]) == {"fz-w0", "fz-w1"}
+        assert led["by_session"] == {"fz": pytest.approx(dev, abs=1e-5)}
+
+    def test_trace_has_all_processes_and_flows(self, forensic_fleet_run):
+        trace = traceviz.to_trace_events(forensic_fleet_run["records"])
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"master", "broker", "fz-w0", "fz-w1"} <= names
+        assert any(e["ph"] == "s" for e in trace["traceEvents"])
+
+    def test_winner_ancestry_reconstructs(self, forensic_fleet_run):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "gentun_trace", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts", "gentun_trace.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        report = mod.build_report(forensic_fleet_run["records"])
+        assert report["winner"]["genome"]
+        assert report["ancestry"]["origin"] in ("founder", "spawn", "reproduce")
+        assert report["cost"]["attribution"]["ratio"] >= 0.99
+        assert mod.render(report)  # text rendering never crashes
+
+
+class TestForensicsOffBitIdentical:
+    def _run(self, forensics):
+        lineage.reset_ledger()
+        if forensics:
+            spans_mod.enable()
+            lineage.enable()
+        pop = Population(OneMax, DATA, size=4, seed=11, maximize=True)
+        eng = AsyncEvolution(pop, tournament_size=3, max_in_flight=1, seed=7,
+                             fidelity_ladder=LADDER, eta=3)
+        best = eng.run(max_evaluations=20)
+        if forensics:
+            lineage.disable()
+            spans_mod.disable()
+        return best.get_genes(), best.get_fitness(), eng.history
+
+    def test_same_trajectory_with_and_without_forensics(self):
+        on = self._run(forensics=True)
+        off = self._run(forensics=False)
+        assert on == off
