@@ -119,6 +119,14 @@ class GentunClient:
       so a fleet's reconnects never stampede a restarted broker in lockstep.
     - ``fault_injector``: optional ``distributed.faults.FaultInjector`` for
       deterministic chaos testing; ``None`` (default) is zero-cost.
+    - ``compile_cache_url``: the fleet-wide compiled-executable cache
+      (``distributed/compile_service.py``).  At join and after
+      :meth:`remesh` — before capacity is (re-)advertised — the worker
+      prefetches the fleet's XLA cache entries for its platform
+      fingerprint into the local cache dir, and publishes whatever it
+      compiles first.  A malformed URL raises ``ValueError`` here (the
+      worker CLI converts it to ``SystemExit``); service downtime never
+      fails a search, it only costs recompiles.
     - ``multihost``: this worker is ONE logical worker spanning a
       multi-process jax cluster (``jax.distributed`` already initialized —
       see ``parallel/multihost.py``).  Process 0 alone owns the broker
@@ -148,6 +156,7 @@ class GentunClient:
         n_chips: Optional[int] = None,
         fitness_store: Optional[str] = None,
         cache_url: Optional[str] = None,
+        compile_cache_url: Optional[str] = None,
         fault_injector=None,
     ):
         self.species = species
@@ -227,6 +236,23 @@ class GentunClient:
             self._cache_client = FitnessServiceClient(cache_url)
             self._store_cache = ServiceBackedCache(
                 self._cache_client, self._store_cache or {})
+        # Fleet-wide compile cache (distributed/compile_service.py):
+        # prefetch the fleet's compiled artifacts into the local XLA cache
+        # dir at join (and after remesh) so this worker loads instead of
+        # compiling, and publish whatever it compiles first.  Refused for
+        # multihost workers: the cache dir is per-host, so the leader
+        # cannot prefetch for its followers — a warm rank 0 racing cold
+        # ranks into the collectives would look exactly like a hang.
+        self._compile_client = None
+        if compile_cache_url:
+            if multihost:
+                raise ValueError(
+                    "compile_cache_url is not supported for multihost workers")
+            from .compile_service import CompileServiceClient
+
+            self._compile_client = CompileServiceClient(
+                compile_cache_url,
+                probe_devices=getattr(species, "uses_jax", False))
         if self.multihost:
             from ..parallel import multihost as mh  # imports jax (opt-in only)
 
@@ -313,6 +339,12 @@ class GentunClient:
             prefetch = min(self.prefetch_depth, 4 * capacity)
         else:
             prefetch = capacity  # the derived-window double-buffer default
+        if self._compile_client is not None:
+            # A remesh changes the mesh shape, i.e. the compile shapes the
+            # next window needs.  Warm the local XLA cache BEFORE the
+            # advertise frame restores credit, so the first post-remesh
+            # window loads instead of compiling.
+            self._compile_client.prefetch()
         self.advertise(capacity=capacity, prefetch_depth=prefetch)
 
     # -- connection --------------------------------------------------------
@@ -498,6 +530,16 @@ class GentunClient:
         _health.register_status_provider("worker", self._ops_status)
         hb = threading.Thread(target=self._heartbeat_loop, name="gentun-heartbeat", daemon=True)
         hb.start()
+        if self._compile_client is not None:
+            # Join-time warmup, BEFORE the first connect advertises
+            # capacity: fetch the fleet's compiled artifacts so the first
+            # dispatched window loads from the XLA disk cache instead of
+            # compiling.  The hook lets models/_prepare_population_setup
+            # trigger publish scans right after potential first compiles.
+            from ..utils.xla_cache import register_publish_hook
+
+            self._compile_client.prefetch()
+            register_publish_hook(self._compile_client.publish_hook)
         backoff = _ReconnectBackoff(self.reconnect_delay, self.reconnect_max_delay, self.worker_id)
         try:
             while (not stop.is_set() and not self._drain_req.is_set()
@@ -525,6 +567,10 @@ class GentunClient:
             self._graceful_close()
             if self._cache_client is not None:
                 self._cache_client.close()
+            if self._compile_client is not None:
+                # close() unregisters the publish hook, runs a final scan
+                # (catching entries the last batch wrote) and flushes.
+                self._compile_client.close()
             _health.unregister_status_provider("worker", self._ops_status)
             _health.unregister_source("worker_heartbeat")
             if self.multihost:
@@ -551,6 +597,8 @@ class GentunClient:
                            "derived_capacity": self._mesh_auto}
         if self._cache_client is not None:
             out["fitness_service"] = self._cache_client.stats()
+        if self._compile_client is not None:
+            out["compile_cache"] = self._compile_client.stats()
         return out
 
     # -- elastic membership -------------------------------------------------
@@ -959,6 +1007,12 @@ class GentunClient:
                 for job in ok_jobs:
                     self._try_send_fail(job["job_id"], f"evaluate: {e!r}")
         self._last_batch_end = time.monotonic()
+        if self._compile_client is not None:
+            # Publish-after-first-compile for every species (the models-
+            # layer hook only covers the jax CNN path): one dir-mtime stat
+            # when nothing changed, a write-behind enqueue when the batch
+            # just wrote new XLA cache entries.
+            self._compile_client.scan_publish()
 
     @staticmethod
     def _check_fidelity(job: Dict[str, Any]) -> Optional[str]:

@@ -146,6 +146,16 @@ enabled/disabled fleets interoperate:
   span reports inherit result-frame dedup: a duplicated frame cannot
   double-ingest.
 
+Cache services are HTTP side channels, not frames: both the shared
+fitness service (``fitness_service.py``, ``--cache-url``) and the
+fleet-wide compile-artifact cache (``compile_service.py``,
+``--compile-cache-url``) run over their own stdlib-HTTP connections,
+never over this socket.  The broker protocol is therefore entirely
+unaware of them — a worker prefetches compiled executables and publishes
+fresh ones out-of-band, and nothing on this wire changes whether the
+services are up, degraded, or absent (that independence is what lets
+cache downtime never fail a search).
+
 Pings are deliberately UNANSWERED: the broker's ``last_seen`` update is
 the liveness mechanism, and replies the worker only reads between batches
 would pile up unread during a long training batch — a worker exiting

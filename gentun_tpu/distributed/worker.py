@@ -160,6 +160,16 @@ def main(argv=None) -> int:
                          "back (write-behind).  Layers OVER --fitness-store; "
                          "degrades to local-only when unreachable.  Not "
                          "available with --coordinator (multihost).")
+    ap.add_argument("--compile-cache-url", default=None, metavar="URL",
+                    help="fleet-wide compiled-executable cache service "
+                         "(distributed/compile_service.py), e.g. "
+                         "http://cache-host:9737: fetch the fleet's XLA "
+                         "cache entries for this platform at join (and "
+                         "after remesh) before advertising capacity, and "
+                         "publish whatever this worker compiles first "
+                         "(write-behind).  Degrades to local compiles when "
+                         "unreachable.  Not available with --coordinator "
+                         "(multihost).")
     ap.add_argument("--fault-plan", default=None, metavar="PATH",
                     help="chaos testing: JSON FaultPlan (distributed/faults.py) "
                          "injected into this worker's client hooks")
@@ -225,6 +235,13 @@ def main(argv=None) -> int:
             args.cache_url = parse_cache_url(args.cache_url)
         except ValueError as e:
             raise SystemExit(f"--cache-url: {e}")
+    if args.compile_cache_url is not None:
+        from .fitness_service import parse_cache_url
+
+        try:
+            args.compile_cache_url = parse_cache_url(args.compile_cache_url)
+        except ValueError as e:
+            raise SystemExit(f"--compile-cache-url: {e}")
     if args.telemetry:
         from ..telemetry import spans as tele_spans
 
@@ -248,6 +265,12 @@ def main(argv=None) -> int:
                          "(same rank-divergence hazard as --fitness-store: a "
                          "cache hit on one host but not another would skip "
                          "training on some ranks only)")
+    if multihost and args.compile_cache_url:
+        raise SystemExit("--compile-cache-url is not supported with "
+                         "--coordinator (the XLA cache dir is per-host, so "
+                         "the leader cannot prefetch for its followers — a "
+                         "warm rank 0 racing cold ranks into the collectives "
+                         "would look exactly like a hang)")
     if multihost:
         # Must happen before ANY jax backend init (so before evaluation);
         # after it, jax.devices() is the global pod-slice device list and
@@ -288,6 +311,7 @@ def main(argv=None) -> int:
         n_chips=args.n_chips,
         fitness_store=args.fitness_store,
         cache_url=args.cache_url,
+        compile_cache_url=args.compile_cache_url,
         fault_injector=injector,
     )
     # Elastic-fleet exit protocol (DISTRIBUTED.md "Elastic fleet"): first

@@ -68,7 +68,12 @@ from ..parallel.multihost import fetch, place, place_tree
 from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..utils.jax_state import mark_backend_used
-from ..utils.xla_cache import default_cache_dir, enable_compilation_cache
+from ..telemetry.registry import get_registry as _get_registry
+from ..utils.xla_cache import (
+    default_cache_dir,
+    enable_compilation_cache,
+    run_publish_hooks,
+)
 from .generic import GentunModel
 
 __all__ = ["MaskedGeneticCnn", "GeneticCnnModel"]
@@ -389,13 +394,18 @@ def _tele_device_span(kind_key, t0, result, attrs):
     is enabled), then record `compile` for a first-seen program shape and
     the phase kind (`train`/`eval`) afterwards."""
     jax.block_until_ready(result)
+    dur = time.monotonic() - t0
     if kind_key in _tele_seen_programs:
         kind = attrs.pop("_kind")
     else:
         _tele_seen_programs.add(kind_key)
         attrs["phase"] = attrs.pop("_kind")
         kind = "compile"
-    _tele.record_span(kind, t0, time.monotonic() - t0, attrs=attrs)
+        # First-compile latency histogram (docs/OBSERVABILITY.md): what a
+        # compile-cache hit saves.  Same honesty caveat as the span kind —
+        # this is compile + first execution.
+        _get_registry().histogram("compile_seconds").observe(dur)
+    _tele.record_span(kind, t0, dur, attrs=attrs)
 
 
 def _run_segmented(
@@ -912,6 +922,12 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
         cache_dir = None
     if cache_dir:
         enable_compilation_cache(cache_dir)
+    # Fleet-wide compile cache (distributed/compile_service.py): a worker
+    # with a compile-cache client registered a hook here; this announces
+    # "the previous evaluation may have been a first compile — scan and
+    # publish what it wrote".  With no hooks (the default) this is one
+    # empty-list iteration.
+    run_publish_hooks()
 
     # Everything below touches devices (auto_mesh → jax.devices()); record
     # that publicly so the GA's per-chip metric can consult device counts
@@ -944,8 +960,6 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     # this at 0; see DISTRIBUTED.md "Host-level mesh workers").  Plain
     # registry writes — a couple of dict ops, cheap enough to stay
     # unconditional so `/metrics` is truthful even with spans off.
-    from ..telemetry.registry import get_registry as _get_registry
-
     _reg = _get_registry()
     _pop_ax, _data_ax = mesh_axis_sizes(mesh)
     _reg.gauge("mesh_pop_axis").set(_pop_ax)

@@ -28,14 +28,30 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
-__all__ = ["enable_compilation_cache", "default_cache_dir"]
+__all__ = [
+    "cache_stats",
+    "default_cache_dir",
+    "enable_compilation_cache",
+    "list_cache_entries",
+    "register_publish_hook",
+    "run_publish_hooks",
+    "unregister_publish_hook",
+]
 
 logger = logging.getLogger("gentun_tpu")
 
 _enabled_dir: Optional[str] = None
 _failed_dirs: set = set()  # dirs that failed makedirs — don't retry/re-warn
+_missing_knobs: set = set()  # jax config keys this jax lacks — warn once each
+
+# Publish hooks: the compile cache service client
+# (``distributed/compile_service.py``) registers its scan-and-publish here
+# so ``models/cnn.py`` can announce "a first compile may just have written
+# an entry" without the models layer importing the distributed package
+# (which would pull the broker stack into every model import).
+_publish_hooks: list = []
 
 
 def default_cache_dir() -> Optional[str]:
@@ -90,11 +106,125 @@ def enable_compilation_cache(cache_dir: str) -> Optional[str]:
         return None
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception as e:  # noqa: BLE001 - version probe, not control flow
+        # A jax without the persistent cache at all (ancient or exotic
+        # build): degrade loudly instead of raising out of every entry
+        # point — the training path must survive, it just recompiles.
+        _failed_dirs.add(cache_dir)
+        logger.warning(
+            "this jax (%s) does not support the persistent compilation "
+            "cache (%s); caching DISABLED — restarts and elastic joins "
+            "will pay full recompiles", getattr(jax, "__version__", "?"), e)
+        return None
+    if _enabled_dir is not None and _enabled_dir != cache_dir:
+        # jax materializes its cache object lazily and keeps it for the
+        # process lifetime: without a reset, writes keep landing in the OLD
+        # dir even though the config now names the new one (silently, as a
+        # UserWarning per entry once the old dir disappears).
+        try:
+            from jax.experimental.compilation_cache import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception as e:  # noqa: BLE001 - version probe
+            logger.warning(
+                "could not reset jax's compilation-cache object while "
+                "switching %s -> %s (%s); cache writes may keep using the "
+                "old directory", _enabled_dir, cache_dir, e)
     # GA fitness programs compile in well under the default 1 s threshold on
-    # CPU test runs; cache everything.
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # CPU test runs; cache everything.  jax versions that lack these knobs
+    # keep the cache enabled with their default thresholds — degraded
+    # loudly (once per knob), because small programs may silently not be
+    # cached there.
+    # The third knob makes cache keys independent of the cache dir PATH:
+    # by default jax derives an xla_gpu_per_fusion_autotune_cache_dir
+    # under the cache dir and hashes that absolute path into every cache
+    # key, so two hosts mounting the cache at different paths could never
+    # reuse each other's artifacts through the compile service.
+    for knob, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_enable_xla_caches", "none")):
+        try:
+            jax.config.update(knob, value)
+        except Exception as e:  # noqa: BLE001 - version probe
+            if knob not in _missing_knobs:
+                _missing_knobs.add(knob)
+                logger.warning(
+                    "this jax (%s) has no %s config key (%s); the "
+                    "persistent cache stays enabled with jax's default "
+                    "threshold — small/fast programs may not be cached",
+                    getattr(jax, "__version__", "?"), knob, e)
     _enabled_dir = cache_dir
     logger.info("persistent XLA compilation cache enabled at %s", cache_dir)
     return cache_dir
+
+
+def list_cache_entries(cache_dir: Optional[str] = None) -> Dict[str, Tuple[int, float]]:
+    """``{entry_name: (size_bytes, mtime)}`` for the cache directory.
+
+    Entry names are jax's own cache-key hashes — they already encode the
+    program, compile options and topology, which is what makes them valid
+    content addresses for the compile service.  Dotfiles (in-flight
+    ``.tmp`` writes) and subdirectories are skipped.  Defaults to the
+    currently-enabled dir, falling back to :func:`default_cache_dir`.
+    A missing directory is an empty cache, not an error.
+    """
+    d = cache_dir if cache_dir is not None else (_enabled_dir or default_cache_dir())
+    if d is None:
+        return {}
+    out: Dict[str, Tuple[int, float]] = {}
+    try:
+        with os.scandir(d) as it:
+            for entry in it:
+                if entry.name.startswith("."):
+                    continue
+                try:
+                    if not entry.is_file(follow_symlinks=False):
+                        continue
+                    st = entry.stat(follow_symlinks=False)
+                except OSError:
+                    continue
+                out[entry.name] = (st.st_size, st.st_mtime)
+    except FileNotFoundError:
+        return {}
+    return out
+
+
+def cache_stats(cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Entry count + total bytes for ``/statusz``-style reporting."""
+    d = cache_dir if cache_dir is not None else (_enabled_dir or default_cache_dir())
+    entries = list_cache_entries(d)
+    return {
+        "dir": d,
+        "enabled": _enabled_dir is not None and d == _enabled_dir,
+        "entries": len(entries),
+        "bytes": sum(size for size, _mtime in entries.values()),
+    }
+
+
+def register_publish_hook(fn: Callable[[], Any]) -> None:
+    """Register a zero-arg callable to run after potential first compiles."""
+    if fn not in _publish_hooks:
+        _publish_hooks.append(fn)
+
+
+def unregister_publish_hook(fn: Callable[[], Any]) -> None:
+    _publish_hooks[:] = [h for h in _publish_hooks if h != fn]
+
+
+def run_publish_hooks() -> None:
+    """Run registered hooks; a failing hook never takes the caller down.
+
+    Called from ``models/cnn.py::_prepare_population_setup`` right after
+    the compile path runs — with no hooks registered this is one empty
+    list iteration, so the default (service-less) configuration pays
+    nothing.
+    """
+    for fn in list(_publish_hooks):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - hook boundary by design
+            logger.warning("compile-cache publish hook %r failed", fn,
+                           exc_info=True)

@@ -717,6 +717,114 @@ def run_forensics_act() -> dict:
     }
 
 
+def run_recompile_storm() -> dict:
+    """Mass-remesh compile storm with the executable cache up: fleet-wide
+    compiles must collapse to ~1 per ``(pop_bucket, static-key)`` shape.
+
+    Simulates the worst elastic moment — every host remeshing and needing
+    every program shape at once — against a REAL ``CompileService`` and
+    real clients, with the compile itself stubbed (a deterministic
+    artifact blob per shape; the jax-compile version of this act lives in
+    ``scripts/compile_cache_study.py``).  Each simulated host owns a
+    private XLA cache dir, prefetches at (re)join exactly like
+    ``GentunClient.remesh()``, "compiles" only the shapes still missing
+    locally, and publishes what it compiled.  Asserts: total compiles ==
+    number of shapes (the first host pays them all, every later host
+    fetches), and a concurrent same-shape race stays idempotent."""
+    import base64
+    import shutil
+    import tempfile
+
+    from gentun_tpu.distributed.compile_service import (
+        CompileService,
+        CompileServiceClient,
+    )
+
+    n_hosts, shapes = 4, [
+        ("pop16", "sk-a"), ("pop16", "sk-b"), ("pop32", "sk-a"),
+        ("pop32", "sk-c"), ("pop64", "sk-d"),
+    ]
+
+    def entry_name(shape):
+        # Stand-in for jax's cache-key hash: deterministic per shape.
+        return "xla_" + base64.b16encode(
+            f"{shape[0]}/{shape[1]}".encode()).decode().lower()
+
+    svc = CompileService(port=0).start()
+    root = tempfile.mkdtemp(prefix="recompile-storm-")
+    compiles_per_shape: dict = {s: 0 for s in shapes}
+    fetches = 0
+    t0 = time.monotonic()
+    try:
+        for h in range(n_hosts):
+            cache_dir = os.path.join(root, f"host{h}")
+            client = CompileServiceClient(svc.url, cache_dir=cache_dir,
+                                          fingerprint="storm-fp")
+            fetches += client.prefetch()  # the remesh()-before-advertise step
+            local = set(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else set()
+            for shape in shapes:
+                name = entry_name(shape)
+                if name in local:
+                    continue  # prefetched: this host skips the compile
+                os.makedirs(cache_dir, exist_ok=True)
+                with open(os.path.join(cache_dir, name), "wb") as fh:
+                    fh.write(f"artifact:{shape}".encode() * 64)
+                compiles_per_shape[shape] += 1
+            client.scan_publish()
+            assert client.flush(10.0), "publish queue failed to drain"
+            client.close()
+
+        # Concurrent same-shape race: two late hosts compile the SAME new
+        # shape simultaneously (prefetch raced the publish) — duplicate
+        # publishes must stay idempotent, one stored blob.
+        race_shape = ("pop128", "sk-race")
+        race_clients = []
+        for h in range(2):
+            cache_dir = os.path.join(root, f"race{h}")
+            os.makedirs(cache_dir)
+            with open(os.path.join(cache_dir, entry_name(race_shape)), "wb") as fh:
+                fh.write(b"race-artifact" * 64)
+            race_clients.append(CompileServiceClient(
+                svc.url, cache_dir=cache_dir, fingerprint="storm-fp"))
+        ts = [threading.Thread(target=c.scan_publish) for c in race_clients]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for c in race_clients:
+            assert c.flush(10.0)
+            c.close()
+        svc_stats = svc.stats()
+        wall = time.monotonic() - t0
+    finally:
+        svc.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    total_compiles = sum(compiles_per_shape.values())
+    max_per_shape = max(compiles_per_shape.values())
+    assert max_per_shape <= 1, (
+        f"a shape compiled more than once fleet-wide: {compiles_per_shape}")
+    assert total_compiles == len(shapes), (
+        f"expected exactly one compile per shape, got {compiles_per_shape}")
+    assert fetches == (n_hosts - 1) * len(shapes), (
+        f"late hosts should have fetched every shape: {fetches}")
+    assert svc_stats["entries"] == len(shapes) + 1  # + the race shape, once
+
+    return {
+        "hosts": n_hosts,
+        "shapes": [list(s) for s in shapes],
+        "compiles_per_shape": {f"{p}/{k}": v for (p, k), v
+                               in compiles_per_shape.items()},
+        "total_compiles": total_compiles,
+        "max_compiles_per_shape_fleet_wide": max_per_shape,
+        "artifacts_fetched_instead_of_compiled": fetches,
+        "concurrent_same_shape_publishes_idempotent": True,
+        "service": {k: svc_stats[k] for k in
+                    ("entries", "bytes", "puts", "evictions", "conflicts")},
+        "wall_s": round(wall, 3),
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
@@ -724,6 +832,7 @@ if __name__ == "__main__":
     out["ladder"] = run_ladder_act()
     out["cache_service"] = run_cache_chaos()
     out["forensics"] = run_forensics_act()
+    out["recompile_storm"] = run_recompile_storm()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

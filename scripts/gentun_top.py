@@ -41,6 +41,10 @@ _HEADLINE_COUNTERS = (
     "fitness_service_hits_total",
     "fitness_service_misses_total",
     "fitness_service_evictions_total",
+    "compile_cache_hits_total",
+    "compile_cache_misses_total",
+    "compile_cache_publishes_total",
+    "compile_cache_evictions_total",
     "worker_drains_total",
     "session_rejected_total",
     "session_quarantined_total",
@@ -237,6 +241,23 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                      f"hit-rate {'-' if rate is None else f'{rate:.1%}'}  "
                      f"pending-publish {cache.get('pending_publish')}  "
                      f"local {cache.get('local_entries', '-')}")
+
+    # Compile-cache panel: the fleet-wide executable cache
+    # (distributed/compile_service.py).  Workers started with
+    # --compile-cache-url surface their client block in _ops_status;
+    # "fetched" artifacts are compiles this worker skipped, while
+    # "compiled local" are shapes it paid for and published to the fleet.
+    cc = statusz.get("compile_cache") or (worker or {}).get("compile_cache")
+    if cc:
+        state = (f"{R}DEGRADED (local compiles){X}" if cc.get("degraded")
+                 else f"{G}connected{X}")
+        fp = cc.get("fingerprint")
+        lines.append(f"{B}compile cache{X}  {cc.get('url')}  {state}  "
+                     f"fetched {cc.get('fetched')}  "
+                     f"compiled-local {cc.get('compiled_local')}  "
+                     f"published {cc.get('published')}  "
+                     f"pending-publish {cc.get('pending_publish')}  "
+                     f"{D}platform {fp if fp else '-'}{X}")
 
     # Chip-hour cost panel (search forensics, docs/OBSERVABILITY.md): the
     # "cost" status provider exists only while the lineage plane is on —

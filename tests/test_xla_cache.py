@@ -14,7 +14,12 @@ import textwrap
 
 import pytest
 
-from gentun_tpu.utils.xla_cache import default_cache_dir, enable_compilation_cache
+from gentun_tpu.utils.xla_cache import (
+    cache_stats,
+    default_cache_dir,
+    enable_compilation_cache,
+    list_cache_entries,
+)
 
 RUN_CV = textwrap.dedent(
     """
@@ -88,6 +93,44 @@ class TestPersistentCompilationCache:
             assert default_cache_dir() is None
 
 
+class TestEntryListing:
+    """The helpers the compile service client builds its publish scans on
+    (distributed/compile_service.py)."""
+
+    def test_lists_regular_files_with_size_and_mtime(self, tmp_path):
+        d = tmp_path / "cache"
+        d.mkdir()
+        (d / "entry_a").write_bytes(b"x" * 10)
+        (d / "entry_b").write_bytes(b"y" * 20)
+        (d / ".fetch-123.tmp").write_bytes(b"torn")  # in-flight write
+        (d / "subdir").mkdir()
+        entries = list_cache_entries(str(d))
+        assert set(entries) == {"entry_a", "entry_b"}
+        size, mtime = entries["entry_a"]
+        assert size == 10 and mtime > 0
+
+    def test_missing_dir_is_empty_cache_not_error(self, tmp_path):
+        assert list_cache_entries(str(tmp_path / "nope")) == {}
+
+    def test_cache_stats_totals(self, tmp_path):
+        d = tmp_path / "cache"
+        d.mkdir()
+        (d / "entry_a").write_bytes(b"x" * 10)
+        (d / "entry_b").write_bytes(b"y" * 20)
+        st = cache_stats(str(d))
+        assert st["entries"] == 2
+        assert st["bytes"] == 30
+        assert st["dir"] == str(d)
+
+    def test_disabled_cache_stats(self, monkeypatch):
+        from gentun_tpu.utils import xla_cache
+
+        monkeypatch.setattr(xla_cache, "_enabled_dir", None)
+        monkeypatch.setenv("GENTUN_TPU_CACHE_DIR", "off")
+        assert list_cache_entries() == {}
+        assert cache_stats()["entries"] == 0
+
+
 class TestCacheOptOutAndDegrade:
     def test_unwritable_dir_degrades_with_warning(self, caplog):
         import logging
@@ -115,6 +158,68 @@ class TestCacheOptOutAndDegrade:
         # still recognized as already-active.
         assert xla_cache._enabled_dir == os.path.abspath(good)
         assert xla_cache.enable_compilation_cache(good) == os.path.abspath(good)
+
+    def test_switching_dirs_resets_jax_cache_object(self, tmp_path, monkeypatch):
+        """jax materializes its cache object lazily and keeps it forever;
+        a dir switch must reset it or writes keep landing in the OLD dir."""
+        from jax.experimental.compilation_cache import compilation_cache as cc
+
+        from gentun_tpu.utils import xla_cache
+
+        calls = []
+        monkeypatch.setattr(cc, "reset_cache", lambda: calls.append(1))
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        assert xla_cache.enable_compilation_cache(a) == os.path.abspath(a)
+        n0 = len(calls)  # a previous test in this process may have switched
+        assert xla_cache.enable_compilation_cache(a) == os.path.abspath(a)
+        assert len(calls) == n0, "same-dir re-enable must not reset"
+        assert xla_cache.enable_compilation_cache(b) == os.path.abspath(b)
+        assert len(calls) == n0 + 1, "dir switch must reset jax's cache object"
+
+    def test_missing_config_knobs_degrade_loudly(self, tmp_path, caplog, monkeypatch):
+        """A jax without the threshold knobs keeps the cache ENABLED (with
+        jax's default thresholds) and warns once — it must never raise out
+        of an entry point."""
+        import logging
+
+        import jax
+
+        from gentun_tpu.utils import xla_cache
+
+        real_update = jax.config.update
+
+        def picky_update(name, value):
+            if name.startswith("jax_persistent_cache_min"):
+                raise AttributeError(f"no config key {name}")
+            return real_update(name, value)
+
+        monkeypatch.setattr(jax.config, "update", picky_update)
+        monkeypatch.setattr(xla_cache, "_missing_knobs", set())
+        d = str(tmp_path / "degraded")
+        with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+            assert xla_cache.enable_compilation_cache(d) == os.path.abspath(d)
+            # Idempotent second call: no duplicate warnings.
+            assert xla_cache.enable_compilation_cache(d) == os.path.abspath(d)
+        knob_warnings = [r for r in caplog.records if "config key" in r.message]
+        assert len(knob_warnings) == 2  # one per missing knob, warned once
+
+    def test_jax_without_persistent_cache_disables_loudly(self, tmp_path, caplog, monkeypatch):
+        import logging
+
+        import jax
+
+        from gentun_tpu.utils import xla_cache
+
+        def no_cache_update(name, value):
+            raise AttributeError(f"no config key {name}")
+
+        monkeypatch.setattr(jax.config, "update", no_cache_update)
+        d = str(tmp_path / "unsupported")
+        with caplog.at_level(logging.WARNING, logger="gentun_tpu"):
+            assert xla_cache.enable_compilation_cache(d) is None
+        assert any("caching DISABLED" in r.message for r in caplog.records)
+        # The failure is remembered: no retry storm on later entry points.
+        assert os.path.abspath(d) in xla_cache._failed_dirs
 
     def test_cache_dir_false_is_programmatic_opt_out(self, monkeypatch):
         import jax
