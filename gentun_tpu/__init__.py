@@ -33,6 +33,7 @@ from .individuals import BoostingIndividual, GeneticCnnIndividual, Individual, X
 from .populations import GridPopulation, Population
 from .algorithms import GeneticAlgorithm, RussianRouletteGA
 from .algorithms_async import AsyncEvolution
+from .surrogate import FitnessSurrogate, SurrogateGate
 from . import telemetry  # noqa: F401  (zero-dependency; see docs/OBSERVABILITY.md)
 
 __all__ = [
@@ -54,6 +55,8 @@ __all__ = [
     "GeneticAlgorithm",
     "RussianRouletteGA",
     "AsyncEvolution",
+    "FitnessSurrogate",
+    "SurrogateGate",
 ]
 
 __version__ = "0.6.0"  # keep in sync with pyproject.toml

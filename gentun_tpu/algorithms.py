@@ -167,7 +167,8 @@ class GeneticAlgorithm:
                                 _lineage.genome_key(father.get_genes()),
                             ],
                             op="reproduce",
-                            generation=self.generation + 1)
+                            generation=self.generation + 1,
+                            genes=child.get_genes())
                     next_individuals.append(child)
 
             # clone_with keeps the population's concrete type across

@@ -50,6 +50,18 @@ member at its highest completed rung) and the proxy/full results live
 under disjoint fitness-cache keys (the overlay is part of the key).
 ``fidelity_ladder=None`` (default) is the pre-ladder engine, bit for bit.
 See DISTRIBUTED.md "Multi-fidelity evolution".
+
+Surrogate rung −1 (``surrogate=``): a :class:`~gentun_tpu.surrogate.SurrogateGate`
+threads a host-side learned ranker UNDER the ladder — every bred child is
+scored before dispatch and only the top ``1/eta`` fraction of recent
+scores enters rung 0; a rejected child is recorded (``gate_rejected``
+lineage event + counter) and immediately replaced by re-breeding, so the
+in-flight target stays saturated and rejected children never consume
+budget.  The gate feeds on every completion, refits periodically, and
+serializes into checkpoint schema v4 (model + window + pending
+decisions), so kill/resume mid-gate is bit-identical.  ``surrogate=None``
+(default) is the ungated engine, bit for bit — the sites read one
+attribute.  See DISTRIBUTED.md "Surrogate rung −1".
 """
 
 from __future__ import annotations
@@ -65,6 +77,7 @@ import numpy as np
 
 from .individuals import Individual
 from .populations import Population
+from .surrogate import SurrogateGate
 from .telemetry import health as _health
 from .telemetry import lineage as _lineage
 from .telemetry import spans as _tele
@@ -295,6 +308,13 @@ class AsyncEvolution:
     eta:
         ASHA reduction factor: one promotion slot per ``eta`` completions
         at a rung.  Ignored without a ladder.
+    surrogate:
+        ``None`` (default): no rung −1, the engine bit for bit.
+        Otherwise a :class:`~gentun_tpu.surrogate.SurrogateGate` that
+        scores every bred child on the host before dispatch and admits
+        only the top fraction; rejected children are re-bred in place
+        (they never occupy a slot or consume budget).  Checkpoints carry
+        the gate (schema v4); on resume the checkpoint's gate state wins.
     """
 
     def __init__(
@@ -307,6 +327,7 @@ class AsyncEvolution:
         job_timeout: Optional[float] = None,
         fidelity_ladder: Optional[Sequence[Mapping[str, Any]]] = None,
         eta: int = 4,
+        surrogate: Optional[SurrogateGate] = None,
     ):
         self.population = population
         self.tournament_size = int(tournament_size)
@@ -325,6 +346,9 @@ class AsyncEvolution:
         else:
             self._ladder = None
         self.eta = int(eta)
+        #: rung −1 — ``None`` is the ungated engine (every site below
+        #: reads this one attribute, the PR-2 off-path contract).
+        self._surrogate = surrogate
         #: per-rung fitnesses of every completion at that rung, in
         #: completion order — the ASHA promotion quota reads this, so it is
         #: serialized for deterministic resume.
@@ -447,6 +471,13 @@ class AsyncEvolution:
             self.pop_size, budget, self.completed, self._cap,
         )
         self._status_session = getattr(self.population, "session", None) or "default"
+        if self._surrogate is not None:
+            # Bind the gate to this search (objective direction, per-tenant
+            # dataset space, warm-start).  Idempotent — a resumed gate
+            # (checkpoint carried ``prepared``) skips the refetch.
+            self._surrogate.prepare(
+                self.population.individuals[0].get_genes(),
+                self.population.maximize, session=self._status_session)
         _health.register_engine_status(self._status_session, self._ops_status)
         with _tele.span("run", {"mode": "async", "budget": budget,
                                 "max_in_flight": self._cap}) as run_span:
@@ -542,6 +573,8 @@ class AsyncEvolution:
                 }
                 for r in range(len(self._ladder))
             ]
+        if self._surrogate is not None:
+            status["surrogate"] = self._surrogate.status()
         return status
 
     # -- internals ---------------------------------------------------------
@@ -571,8 +604,30 @@ class AsyncEvolution:
                     "born", _lineage.genome_key(child.get_genes()),
                     parents=[_lineage.genome_key(mother.get_genes()),
                              _lineage.genome_key(father.get_genes())],
-                    op="reproduce")
+                    op="reproduce", genes=child.get_genes())
             return child
+
+    def _next_child(self) -> Individual:
+        """Breed the next dispatchable child — through the surrogate gate
+        (rung −1) when one is attached.  A rejected child is recorded
+        (``gate_rejected`` lineage event + counter inside the gate) and
+        immediately replaced by re-breeding, so the caller always gets a
+        child and the in-flight target stays saturated; the gate's
+        reject-streak cap bounds the loop.  Rejections happen BEFORE the
+        dispatch count, so they never consume budget."""
+        child = self._breed()
+        gate = self._surrogate
+        if gate is None:
+            return child
+        while True:
+            admit, score = gate.decide(child.get_genes(), rung=0)
+            if admit:
+                return child
+            if _lineage.enabled():
+                _lineage.record(
+                    "gate_rejected", _lineage.genome_key(child.get_genes()),
+                    score=score, rung=0)
+            child = self._breed()
 
     def _tag_fidelity(self, work: _Work) -> None:
         """Stamp the wire fidelity tag on an outgoing individual (OPTIONAL
@@ -606,7 +661,7 @@ class AsyncEvolution:
             if self._queue:
                 work = self._queue.pop(0)
             elif self._can_breed():
-                work = _Work(self._breed(), False)
+                work = _Work(self._next_child(), False)
             else:
                 break  # nothing evaluated yet: wait for the cohort
             self.dispatched += 1
@@ -715,6 +770,12 @@ class AsyncEvolution:
             ind._rung = work.rung
         self._update_best(work, float(fitness))
         self.completed += 1
+        if self._surrogate is not None:
+            # Every completion trains rung −1 (members, probes, cached and
+            # failed-over followers alike) and resolves the child's pending
+            # gate decision into the precision@k buffer.
+            self._surrogate.observe_result(
+                ind.get_genes(), work.rung, float(fitness))
         if _lineage.enabled():
             _lineage.record(
                 "completed", _lineage.genome_key(ind.get_genes()),
@@ -862,6 +923,8 @@ class AsyncEvolution:
         retries the same doomed promotion)."""
         logger.warning("async evaluation failed permanently: %s", reason)
         ind = work.ind
+        if self._surrogate is not None:
+            self._surrogate.forget(ind.get_genes())
         if _lineage.enabled():
             _lineage.record(
                 "failed", _lineage.genome_key(ind.get_genes()),
@@ -1003,6 +1066,11 @@ class AsyncEvolution:
                 {"rung": r, "genes": b.get_genes(), "fitness": b.get_fitness()}
                 for r, b in sorted(self._best_by_rung.items())
             ]
+        if self._surrogate is not None:
+            # Schema v4: the whole rung −1 — model weights AND training
+            # samples, score window, pending gate decisions — so a killed
+            # master resumes the gated trajectory bit-identically.
+            state["surrogate"] = self._surrogate.state_dict()
         return state
 
     def _member_state(self, ind: Individual) -> Dict[str, Any]:
@@ -1058,6 +1126,17 @@ class AsyncEvolution:
                 for rung in state.get("rung_completions",
                                       [[] for _ in self._ladder])
             ]
+        # Surrogate state (schema v4).  The checkpoint's gate wins over the
+        # constructor's (same precedent as the ladder): a resumed run
+        # continues the SAME gated search.  A v3 file (no "surrogate" key)
+        # under a gated ctor keeps the ctor's fresh gate — it just starts
+        # untrained, i.e. admit-all.
+        sur_state = state.get("surrogate")
+        if sur_state is not None:
+            if self._surrogate is None:
+                self._surrogate = SurrogateGate.from_state(sur_state)
+            else:
+                self._surrogate.load_state_dict(sur_state)
         individuals = []
         for ind_state in pop_state["individuals"]:
             ind = self.population.spawn(genes=ind_state["genes"])

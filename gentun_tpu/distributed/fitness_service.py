@@ -199,6 +199,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": "entries must be a list"})
                 return
             self._send_json(200, {"stored": svc.publish(entries)})
+        elif path == "/v1/dataset/publish":
+            space, rows = msg.get("space"), msg.get("rows")
+            if not isinstance(space, str) or not isinstance(rows, list):
+                self._send_json(400, {"error": "space must be a string and "
+                                               "rows a list"})
+                return
+            self._send_json(200, {"stored": svc.publish_dataset(space, rows)})
+        elif path == "/v1/dataset/fetch":
+            space = msg.get("space")
+            if not isinstance(space, str):
+                self._send_json(400, {"error": "space must be a string"})
+                return
+            self._send_json(200, {
+                "rows": svc.fetch_dataset(space, msg.get("limit"))})
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
@@ -216,12 +230,19 @@ class FitnessService:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_entries: int = 100_000):
+                 max_entries: int = 100_000, max_dataset_rows: int = 50_000):
         if max_entries <= 0:
             raise ValueError(f"max_entries must be positive, got {max_entries}")
         self.max_entries = int(max_entries)
+        self.max_dataset_rows = int(max_dataset_rows)
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, float]" = OrderedDict()
+        # Surrogate training rows, keyed (space, genome, rung) so
+        # re-publishes dedup — the side table the rung −1 gate warm-starts
+        # from and syncs with at refit boundaries (surrogate.py).  Bounded
+        # like the fitness table: oldest rows fall off fleet-wide.
+        self._dataset: "OrderedDict[Tuple[str, str, int], Dict[str, Any]]" = OrderedDict()
+        self._dataset_puts = 0
         self._hits = 0
         self._misses = 0
         self._evictions = 0
@@ -307,11 +328,59 @@ class FitnessService:
             _get_registry().counter("fitness_service_evictions_total").inc(evicted)
         return stored
 
+    def publish_dataset(self, space: str, rows: List[Any]) -> int:
+        """Store surrogate training rows under a per-tenant space key.
+
+        A row is ``{"genome": key, "genes": {...}, "rung": r,
+        "fitness": f}``; the service treats ``genes`` opaquely (each
+        master re-encodes with its own feature map), validating only the
+        dedup key and the label.  Rows keyed ``(space, genome, rung)``,
+        latest measurement wins."""
+        stored = 0
+        with self._lock:
+            for row in rows:
+                if not isinstance(row, dict):
+                    continue
+                genome = row.get("genome")
+                if not isinstance(genome, str) or not isinstance(
+                        row.get("genes"), dict):
+                    continue
+                try:
+                    rung = int(row.get("rung", 0))
+                    fitness = float(row["fitness"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                key = (str(space), genome, rung)
+                self._dataset[key] = {"genome": genome, "genes": row["genes"],
+                                      "rung": rung, "fitness": fitness}
+                self._dataset.move_to_end(key)
+                stored += 1
+            self._dataset_puts += stored
+            while len(self._dataset) > self.max_dataset_rows:
+                self._dataset.popitem(last=False)
+        return stored
+
+    def fetch_dataset(self, space: str, limit: Any = None) -> List[Dict[str, Any]]:
+        """The space's rows, oldest first (a bounded trainer keeps the
+        freshest when it truncates from the front)."""
+        try:
+            cap = None if limit is None else max(0, int(limit))
+        except (TypeError, ValueError):
+            cap = None
+        with self._lock:
+            rows = [row for (sp, _, _), row in self._dataset.items()
+                    if sp == space]
+        if cap is not None and len(rows) > cap:
+            rows = rows[-cap:]
+        return rows
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "entries": len(self._entries),
                 "max_entries": self.max_entries,
+                "dataset_rows": len(self._dataset),
+                "dataset_puts": self._dataset_puts,
                 "hits": self._hits,
                 "misses": self._misses,
                 "evictions": self._evictions,
@@ -433,6 +502,39 @@ class FitnessServiceClient:
             self._hits += len(clean)
             self._misses += len(keys) - len(clean)
         return clean
+
+    def publish_dataset(self, space: str, rows: List[Dict[str, Any]]) -> Optional[int]:
+        """Ship surrogate training rows; ``None`` on degradation/failure.
+
+        Synchronous by design — the rung −1 gate calls this only at refit
+        boundaries (every ``refit_every`` completions), never on the
+        score-on-breed hot path, and it needs the verdict to decide
+        whether to degrade to admit-all (surrogate.py)."""
+        if not self.available():
+            return None
+        out = self._post("/v1/dataset/publish",
+                         {"space": str(space), "rows": list(rows)})
+        if out is None:
+            return None
+        try:
+            return int(out.get("stored", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def fetch_dataset(self, space: str,
+                      limit: Optional[int] = None) -> Optional[List[Dict[str, Any]]]:
+        """The space's training rows; ``None`` on degradation/failure
+        (distinct from ``[]``, a healthy-but-empty space)."""
+        if not self.available():
+            return None
+        payload: Dict[str, Any] = {"space": str(space)}
+        if limit is not None:
+            payload["limit"] = int(limit)
+        out = self._post("/v1/dataset/fetch", payload)
+        if out is None:
+            return None
+        rows = out.get("rows")
+        return rows if isinstance(rows, list) else []
 
     def publish(self, entries: List[Tuple[str, float]]) -> None:
         """Queue entries for the write-behind flusher (never blocks)."""

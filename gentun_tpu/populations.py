@@ -105,7 +105,7 @@ class Population:
                 for ind in self.individuals:
                     _lineage.record(
                         "born", _lineage.genome_key(ind.get_genes()),
-                        op="spawn")
+                        op="spawn", genes=ind.get_genes())
         else:
             raise ValueError("provide either `size` or `individual_list`")
 

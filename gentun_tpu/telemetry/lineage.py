@@ -12,9 +12,14 @@ state, bit-identical trajectories when off — docs/OBSERVABILITY.md
   Event taxonomy (emitters in parentheses):
 
   ========================  ====================================================
-  ``born``                  genome created — ``parents`` (genome keys) and
-                            ``op`` (``spawn``/``reproduce``) (both engines,
-                            populations)
+  ``born``                  genome created — ``parents`` (genome keys),
+                            ``op`` (``spawn``/``reproduce``) and ``genes``
+                            (the genome itself, so the ledger doubles as a
+                            surrogate training set — ``gentun_trace.py
+                            dataset``) (both engines, populations)
+  ``gate_rejected``         bred child vetoed by the surrogate rung −1
+                            before dispatch — ``score`` (async engine,
+                            ``surrogate.py``)
   ``dispatched``            job handed to a worker at a rung (broker)
   ``completed``             fitness landed — ``fitness``, ``rung``, ``cached``
                             (async engine)

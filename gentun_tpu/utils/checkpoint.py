@@ -28,6 +28,15 @@ Version history:
   read as rung-0 children), and ladderless runs still write a state v2
   readers would recognize field-for-field — the version is bumped because
   a v2 reader resuming a LADDERED file would silently drop every rung.
+- **4**: adds the surrogate rung −1 state (``AsyncEvolution`` with
+  ``surrogate=``): the ridge model (weights AND training samples), the
+  rolling score window, pending gate decisions (admitted score awaiting
+  its realized fitness), precision@k pairs, and the degradation flag —
+  everything a killed master needs to resume the gated trajectory
+  bit-identically.  v3 (and older) files load fine; the version is
+  bumped because a v3 reader resuming a GATED file would silently drop
+  the model and window, replaying admissions against empty state and
+  diverging from the uninterrupted trajectory.
 
 Loading is backward-compatible (a v1 file loads fine) but not
 forward-compatible: a file stamped NEWER than this code understands is
@@ -47,7 +56,7 @@ __all__ = ["Checkpointer", "load_checkpoint", "namespaced_path",
 
 #: Newest checkpoint layout this code can write and read (see the module
 #: docstring for the version history).
-CHECKPOINT_SCHEMA = 3
+CHECKPOINT_SCHEMA = 4
 
 
 def _to_jsonable(obj: Any) -> Any:

@@ -13,6 +13,7 @@ CPU-only, a few seconds: `python scripts/broker_throughput.py`.
 from __future__ import annotations
 
 import gc
+import itertools
 import json
 import os
 import statistics
@@ -317,6 +318,67 @@ def run_compile_probe_gate(per_job_dispatch_us: float,
     }
 
 
+def run_surrogate_gate(per_job_dispatch_us: float) -> dict:
+    """Score-on-breed hot-path cost of the surrogate rung −1, micro-timed.
+
+    A gated master (``AsyncEvolution(surrogate=...)``) pays one
+    ``SurrogateGate.decide`` per bred child: encode the genome, dot it
+    against the ridge weights, bisect the score into the rolling window,
+    take the quantile cut, and park the pending decision.  Same
+    instrument as the forensics/compile gates: the call is timed directly
+    over 20k invocations against a TRAINED model with a FULL window (the
+    steady-state worst case — an untrained or degraded gate short-circuits
+    to admit-all) on the standard 12-bit (4,4) stage-DAG genome, then
+    divided by the measured per-job dispatch cost — deterministic where
+    wall-clock A/B on this box is +-8% noise."""
+    from gentun_tpu.surrogate import FitnessSurrogate, SurrogateGate
+
+    rng = np.random.default_rng(7)
+    genomes = [
+        {"S_1": tuple(int(b) for b in rng.integers(0, 2, 6)),
+         "S_2": tuple(int(b) for b in rng.integers(0, 2, 6))}
+        for _ in range(64)
+    ]
+    gate = SurrogateGate(FitnessSurrogate(min_train=32, refit_every=32),
+                         eta=4, window=64, min_window=16)
+    gate.prepare(genomes[0], maximize=True)
+    for g in genomes:
+        gate.observe_result(g, 0, float(sum(sum(v) for v in g.values())))
+    assert gate.surrogate.trained, "bench model must be trained"
+    for g in genomes:  # fill the rolling window to capacity
+        gate.decide(g)
+    assert len(gate._scores) == gate.window
+    spans_mod.enable()
+    try:
+        # Batched loop, min of 3 repeats: a per-call lambda + next(cycle)
+        # costs ~0.35us — 4% of the budget — and single samples on this
+        # box carry scheduler noise the min rejects.
+        batch = list(itertools.islice(itertools.cycle(genomes), 2000))
+        decide = gate.decide
+
+        def _loop():
+            for g in batch:
+                decide(g)
+
+        reps, inner = 3, 10
+        t_decide_s = min(timeit.repeat(_loop, number=inner, repeat=reps)) / (
+            inner * len(batch))
+    finally:
+        spans_mod.disable()
+    per_job_added_us = round(t_decide_s * 1e6, 3)
+    overhead_pct = round(per_job_added_us / per_job_dispatch_us * 100.0, 3)
+    return {
+        "decide_us": per_job_added_us,
+        "genome_bits": sum(len(v) for v in genomes[0].values()),
+        "window": gate.window,
+        "per_job_added_us": per_job_added_us,
+        "per_job_dispatch_us": per_job_dispatch_us,
+        "overhead_pct": overhead_pct,
+        "gate_max_pct": 2.0,
+        "within_gate": overhead_pct <= 2.0,
+    }
+
+
 def main() -> dict:
     # Single-tenant pass first (the historical headline numbers), then the
     # same workload split across 4 fair-share sessions: the difference is
@@ -361,6 +423,17 @@ def main() -> dict:
         f"{out['compile_probe']['overhead_pct']}% exceeds the 2% gate "
         f"({out['compile_probe']['per_job_added_us']}us added on "
         f"{out['compile_probe']['per_job_dispatch_us']}us/job dispatch)")
+
+    # Surrogate rung −1 gate (DISTRIBUTED.md "Surrogate rung −1"): the
+    # score-on-breed decide a gated master pays per bred child must also
+    # stay <=2% of per-job dispatch cost.  Same denominator again.
+    out["surrogate"] = run_surrogate_gate(
+        out["forensics"]["per_job_dispatch_us"])
+    assert out["surrogate"]["within_gate"], (
+        f"surrogate score-on-breed overhead "
+        f"{out['surrogate']['overhead_pct']}% exceeds the 2% gate "
+        f"({out['surrogate']['per_job_added_us']}us added on "
+        f"{out['surrogate']['per_job_dispatch_us']}us/job dispatch)")
 
     # Informational (not gated): the full per-job accounting fare.  When a
     # master runs full forensics it stamps `fz` into the propagated trace

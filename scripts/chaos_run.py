@@ -619,6 +619,100 @@ def run_cache_chaos() -> dict:
     }
 
 
+def run_surrogate_act() -> dict:
+    """Surrogate rung −1 under fitness-service downtime: a gated search
+    whose dataset plane (warm-start + refit-boundary sync against the
+    shared fitness service) loses its service mid-run.  The gate must
+    fail OPEN — degrade to admit-all with exactly ONE
+    ``surrogate_degraded`` telemetry event — and the search must still
+    complete its full budget: dataset downtime costs chip-time, never
+    correctness.  The kill is held until the surrogate has refit (and
+    synced) at least twice, so the act proves the degradation path from
+    a *working* gate, not a never-trained one."""
+    from gentun_tpu.distributed.fitness_service import (
+        FitnessService,
+        FitnessServiceClient,
+    )
+    from gentun_tpu.surrogate import FitnessSurrogate, SurrogateGate
+
+    budget = 60
+    svc = FitnessService(port=0).start()
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_surrogate_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-surrogate").install()
+    client = FitnessServiceClient(svc.url, timeout=1.0, cooldown=1.0)
+    gate = SurrogateGate(FitnessSurrogate(min_train=8, refit_every=8),
+                         eta=4, window=32, min_window=8,
+                         dataset_client=client)
+    killed_after = {}
+    t0 = time.monotonic()
+    try:
+        pop = Population(SlowishOneMax, *DATA, size=POP_SIZE, seed=POP_SEED)
+        eng = AsyncEvolution(pop, tournament_size=3, seed=GA_SEED,
+                             surrogate=gate)
+
+        def _kill_service():
+            # Pull the plug only after the gate has trained, refit and
+            # synced against the live service — squarely mid-search.
+            while gate.surrogate.refits < 2:
+                time.sleep(0.005)
+            rows = client.fetch_dataset(gate._space, limit=1000) or []
+            killed_after["refits"] = gate.surrogate.refits
+            killed_after["dataset_rows"] = len(rows)
+            svc.stop()
+
+        killer = threading.Thread(target=_kill_service, daemon=True)
+        killer.start()
+        eng.run(max_evaluations=budget)
+        killer.join(timeout=10)
+        wall = time.monotonic() - t0
+    finally:
+        run_tele.close()
+        try:
+            client.close()
+        except Exception:
+            pass
+        try:
+            svc.stop()
+        except Exception:
+            pass
+
+    assert eng.completed == budget, f"budget not met: {eng.completed}/{budget}"
+    assert killed_after.get("refits", 0) >= 2, (
+        f"service killed before the gate ever synced: {killed_after}")
+    assert killed_after.get("dataset_rows", 0) >= gate.surrogate.min_train, (
+        f"refit-boundary syncs never landed rows on the service: {killed_after}")
+    assert gate.degraded, "service kill never degraded the gate"
+    assert gate.degraded_total == 1, (
+        f"expected ONE up->down transition, got {gate.degraded_total}")
+    assert gate.surrogate.refits > killed_after["refits"], (
+        "local refits must continue while degraded — degradation disables "
+        "gating, not training")
+
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    degraded_events = [r for r in tele_lines
+                       if r.get("type") == "event"
+                       and r.get("name") == "surrogate_degraded"]
+    assert len(degraded_events) == 1, (
+        f"expected ONE surrogate_degraded event, got {len(degraded_events)}")
+
+    return {
+        "budget": budget,
+        "population_size": POP_SIZE,
+        "seeds": {"population": POP_SEED, "engine": GA_SEED},
+        "service_killed_after_refits": killed_after["refits"],
+        "dataset_rows_on_service_at_kill": killed_after["dataset_rows"],
+        "search_completed": True,
+        "gate": gate.status(),
+        "degraded_events": len(degraded_events),
+        "degraded_transitions": gate.degraded_total,
+        "refits_after_kill": gate.surrogate.refits - killed_after["refits"],
+        "wall_s": round(wall, 3),
+    }
+
+
 def run_forensics_act() -> dict:
     """Chaos under the search-forensics plane: with the lineage ledger ON,
     the fault paths must narrate themselves in the run artifact.  A
@@ -831,6 +925,7 @@ if __name__ == "__main__":
     out["async_smoke"] = run_async_smoke()
     out["ladder"] = run_ladder_act()
     out["cache_service"] = run_cache_chaos()
+    out["surrogate"] = run_surrogate_act()
     out["forensics"] = run_forensics_act()
     out["recompile_storm"] = run_recompile_storm()
     print(json.dumps(out, indent=2))

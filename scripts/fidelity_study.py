@@ -235,7 +235,7 @@ def main() -> int:
                      if isinstance(e, dict)]
             if "promotion" in kinds:
                 promotion_in_flight, kill_at = True, at
-                assert state["schema_version"] == 3, state["schema_version"]
+                assert state["schema_version"] == 4, state["schema_version"]
                 resumed, _ = _run(ladder=LADDER, checkpointer=Checkpointer(path))
                 resume_identical = (
                     _history_sig(resumed) == _history_sig(ladder_eng))

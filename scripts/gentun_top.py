@@ -142,6 +142,25 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
             lines.append(f"{B}engine{X} [{e.get('mode', '?')}:{sid}]  {prog}  "
                          f"best {e.get('best_fitness')}  "
                          f"{D}trace {e.get('trace_id')}{X}")
+            sur = e.get("surrogate")
+            if sur:
+                # Surrogate rung −1 panel (DISTRIBUTED.md): is the gate
+                # trained, what fraction of bred children it vetoes, and
+                # whether the dataset-plane sync is degraded (admit-all).
+                total = (sur.get("admitted", 0) or 0) + (sur.get("rejected", 0) or 0)
+                veto = (100.0 * sur.get("rejected", 0) / total) if total else 0.0
+                model = (f"{G}trained{X}" if sur.get("trained")
+                         else f"{Y}warming{X}")
+                prec = sur.get("precision_at_k")
+                prec_s = f"{prec:.2f}" if prec is not None else "-"
+                degraded = (f"  {R}DEGRADED (admit-all){X}"
+                            if sur.get("degraded") else "")
+                lines.append(
+                    f"{B}surrogate{X} [{sid}]  {model}  "
+                    f"admit {sur.get('admitted')} veto {sur.get('rejected')} "
+                    f"({veto:.0f}%)  pending {sur.get('pending')}  "
+                    f"refits {sur.get('refits')}  p@k {prec_s}"
+                    f"{degraded}")
 
     fleet = statusz.get("fleet")
     if fleet:

@@ -9,6 +9,14 @@ the fleet doing while the master thought?
     python scripts/gentun_trace.py report  run/telemetry.jsonl
     python scripts/gentun_trace.py report  run/telemetry.jsonl --json
     python scripts/gentun_trace.py convert run/telemetry.jsonl trace.json
+    python scripts/gentun_trace.py dataset run/telemetry.jsonl rows.jsonl
+
+``dataset`` extracts surrogate training tuples — ``(genome bitstring,
+rung, fitness, device_seconds)`` — by joining each ``completed`` lineage
+event against the genome recorded on its ``born`` event and the
+per-genome ``device`` spans, so the rung −1 training set
+(``gentun_tpu/surrogate.py``) is reconstructable offline from any
+forensics run's ledger.
 
 ``convert`` writes Chrome ``trace_event`` JSON — load it at
 https://ui.perfetto.dev (or ``chrome://tracing``) for the interactive
@@ -241,6 +249,44 @@ def build_report(records: List[Dict[str, Any]],
     return out
 
 
+def extract_dataset(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Surrogate training tuples from a ledger: one row per ``completed``
+    event, carrying the genome (from its ``born`` event — ledgers written
+    before ``born`` recorded ``genes`` are skipped, counted), the rung,
+    the realized fitness, and the device-seconds actually billed to that
+    ``(genome, rung)`` cell (0.0 for cache hits — a free measurement)."""
+    events = _lineage_events(records)
+    genes_by_genome: Dict[str, Any] = {}
+    for e in events:
+        if e.get("event") == "born" and isinstance(e.get("genes"), dict):
+            genes_by_genome.setdefault(str(e.get("genome")), e["genes"])
+    device: Dict[Any, float] = {}
+    for rec in _device_spans(records):
+        a = rec.get("attrs") or {}
+        cell = (str(a.get("genome") or "?"), int(a.get("rung", 0) or 0))
+        device[cell] = device.get(cell, 0.0) + float(rec.get("dur_s", 0.0))
+    rows: List[Dict[str, Any]] = []
+    skipped = 0
+    for e in events:
+        if e.get("event") != "completed" or e.get("fitness") is None:
+            continue
+        g = str(e.get("genome"))
+        genes = genes_by_genome.get(g)
+        if genes is None:
+            skipped += 1  # founder predating genes-on-born, or old ledger
+            continue
+        rung = int(e.get("rung", 0) or 0)
+        rows.append({
+            "genome": g,
+            "genes": genes,
+            "rung": rung,
+            "fitness": float(e["fitness"]),
+            "device_seconds": round(device.get((g, rung), 0.0), 9),
+        })
+    return {"rows": rows, "skipped_no_genes": skipped,
+            "genomes": len(genes_by_genome)}
+
+
 def _count_by(events: List[Dict[str, Any]], field: str) -> Dict[str, int]:
     out: Dict[str, int] = {}
     for e in events:
@@ -335,6 +381,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_rep.add_argument("--genome", default=None,
                        help="root the ancestry at this genome key "
                             "instead of the inferred winner")
+    p_ds = sub.add_parser(
+        "dataset",
+        help="extract (genome, rung, fitness, device_seconds) surrogate "
+             "training rows from the lineage ledger")
+    p_ds.add_argument("jsonl")
+    p_ds.add_argument("out", nargs="?", default=None,
+                      help="output JSONL path (default: stdout)")
     args = ap.parse_args(argv)
 
     if args.cmd == "convert":
@@ -342,6 +395,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         n = len(trace["traceEvents"])
         print(f"wrote {args.out}: {n} trace events "
               f"(load at https://ui.perfetto.dev)")
+        return 0
+
+    if args.cmd == "dataset":
+        ds = extract_dataset(traceviz.load_jsonl(args.jsonl))
+        lines = [json.dumps(r, separators=(",", ":")) for r in ds["rows"]]
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                fh.write("\n".join(lines) + ("\n" if lines else ""))
+        else:
+            for line in lines:
+                print(line)
+        msg = (f"{len(ds['rows'])} training row(s) from "
+               f"{ds['genomes']} genome(s)")
+        if ds["skipped_no_genes"]:
+            msg += (f"; skipped {ds['skipped_no_genes']} completed event(s) "
+                    "without a genes-bearing born event (pre-v12 ledger?)")
+        print(msg, file=sys.stderr)
         return 0
 
     records = traceviz.load_jsonl(args.jsonl)

@@ -164,7 +164,7 @@ class TestCheckpointSchema:
         path = str(tmp_path / "ck.json")
         eng = AsyncEvolution(_pop(), max_in_flight=1, seed=5, checkpoint_every=4)
         eng.run(max_evaluations=12, checkpointer=Checkpointer(path))
-        assert json.load(open(path))["schema_version"] == CHECKPOINT_SCHEMA == 3
+        assert json.load(open(path))["schema_version"] == CHECKPOINT_SCHEMA == 4
 
     def test_newer_schema_refused(self, tmp_path):
         path = str(tmp_path / "ck.json")

@@ -156,7 +156,7 @@ class TestLadderEngine:
 
 
 class TestLadderCheckpoint:
-    def test_schema_v3_round_trip_with_inflight_promotion(self, tmp_path):
+    def test_schema_round_trip_with_inflight_promotion(self, tmp_path):
         ref = _engine(checkpoint_every=2)
         ref.run(max_evaluations=60)
 
@@ -170,7 +170,7 @@ class TestLadderCheckpoint:
             with pytest.raises(MasterKilled):
                 eng.run(max_evaluations=60, checkpointer=Checkpointer(p))
             state = json.load(open(p))
-            assert state["schema_version"] == 3
+            assert state["schema_version"] == 4
             entries = state["in_flight"] + state.get("queued", [])
             if any(e.get("kind") == "promotion" for e in entries):
                 promotion_seen, path = True, p

@@ -1,0 +1,476 @@
+"""Surrogate rung −1 (``surrogate.py``): the ledger-trained fitness
+ranker that gates dispatch under the ASHA ladder.
+
+Covers the PR's acceptance gates: deterministic encoding and ridge
+model, quantile-gate admission semantics (admit-all until trained,
+reject-streak force-admit), fail-open degradation with exactly ONE
+event per transition, warm-start from the dataset plane, checkpoint
+schema v4 round-trips carrying surrogate state + PENDING gate
+decisions, v3 forward-compat in both directions, and the engine-level
+off-path bit-identity contract (PR 2: one attribute read when off).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gentun_tpu import AsyncEvolution, Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import FaultInjector, FaultPlan, FaultSpec
+from gentun_tpu.distributed.faults import MasterKilled
+from gentun_tpu.surrogate import (
+    FitnessSurrogate,
+    SurrogateGate,
+    encode_genes,
+    space_key,
+)
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+from gentun_tpu.utils import CHECKPOINT_SCHEMA, Checkpointer
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+def _pop(size=8, seed=11, **kw):
+    return Population(OneMax, DATA, size=size, seed=seed, maximize=True, **kw)
+
+
+def _genes(bits):
+    return {"S_1": tuple(bits[:6]), "S_2": tuple(bits[6:])}
+
+
+def _rand_genes(rng):
+    return _genes([int(b) for b in rng.integers(0, 2, 12)])
+
+
+def _trained_surrogate(n=40, seed=0, **kw):
+    rng = np.random.default_rng(seed)
+    kw.setdefault("min_train", 16)
+    kw.setdefault("refit_every", 16)
+    sur = FitnessSurrogate(**kw)
+    for _ in range(n):
+        g = _rand_genes(rng)
+        sur.observe(g, 0, float(sum(sum(v) for v in g.values())))
+    return sur
+
+
+class _FakeDatasetClient:
+    """In-memory stand-in for FitnessServiceClient's dataset plane."""
+
+    def __init__(self, rows=None, fail=False):
+        self.rows = list(rows or [])
+        self.fail = fail
+        self.published = []
+
+    def publish_dataset(self, space, rows):
+        if self.fail:
+            return None
+        self.published.append((space, list(rows)))
+        self.rows.extend(rows)
+        return len(rows)
+
+    def fetch_dataset(self, space, limit=4096):
+        if self.fail:
+            return None
+        return list(self.rows)[-limit:]
+
+
+class TestEncoding:
+    def test_bias_sorted_bits_and_rung(self):
+        g = {"S_2": (1, 0), "S_1": (0, 1, 1)}
+        assert encode_genes(g, rung=2) == [1.0, 0.0, 1.0, 1.0, 1.0, 0.0, 2.0]
+
+    def test_scalar_and_exotic_values_are_total(self):
+        g = {"a": 3, "b": "relu"}
+        x = encode_genes(g)
+        assert x[0] == 1.0 and x[1] == 3.0 and 0.0 <= x[2] < 1.0
+        assert x == encode_genes(g)  # hashed column is deterministic
+
+    def test_fixed_width_across_genomes(self):
+        rng = np.random.default_rng(3)
+        widths = {len(encode_genes(_rand_genes(rng))) for _ in range(20)}
+        assert widths == {14}  # bias + 12 bits + rung
+
+    def test_space_key_namespaced_and_width_sensitive(self):
+        g = _genes([0] * 12)
+        assert space_key(g).startswith("default:")
+        assert space_key(g, "tenant-a") != space_key(g)
+        assert space_key(g, "tenant-a") == space_key(_genes([1] * 12), "tenant-a")
+        wider = {"S_1": (0,) * 6, "S_2": (0,) * 8}
+        assert space_key(wider) != space_key(g)
+
+
+class TestFitnessSurrogate:
+    def test_min_train_gate(self):
+        sur = FitnessSurrogate(min_train=4, refit_every=2)
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            assert not sur.observe(_rand_genes(rng), 0, float(i))
+            assert sur.score(_rand_genes(rng)) is None
+        assert sur.observe(_rand_genes(rng), 0, 3.0)  # 4th row fires the fit
+        assert sur.trained
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError, match="min_train"):
+            FitnessSurrogate(min_train=1)
+        with pytest.raises(ValueError, match="refit_every"):
+            FitnessSurrogate(refit_every=0)
+
+    def test_refit_cadence(self):
+        sur = FitnessSurrogate(min_train=4, refit_every=4)
+        rng = np.random.default_rng(1)
+        fired = [sur.observe(_rand_genes(rng), 0, float(i)) for i in range(12)]
+        assert fired == [False] * 3 + [True] + [False] * 3 + [True] + [False] * 3 + [True]
+        assert sur.refits == 3
+
+    def test_learns_onemax_ranking(self):
+        sur = _trained_surrogate()
+        lo = sur.score(_genes([0] * 12))
+        hi = sur.score(_genes([1] * 12))
+        assert lo is not None and hi is not None and hi > lo
+
+    def test_deterministic_given_stream(self):
+        a, b = _trained_surrogate(seed=7), _trained_surrogate(seed=7)
+        assert a._weights == b._weights
+        g = _genes([1, 0] * 6)
+        assert a.score(g) == b.score(g)
+
+    def test_width_mismatch_scores_none(self):
+        sur = _trained_surrogate()
+        assert sur.score({"S_1": (1, 0)}) is None
+
+    def test_max_samples_evicts_oldest(self):
+        sur = FitnessSurrogate(min_train=2, max_samples=8)
+        rng = np.random.default_rng(2)
+        for i in range(20):
+            sur.add_row(f"g{i}", encode_genes(_rand_genes(rng)), float(i))
+        assert sur.n_samples == 8
+        assert ("g0", 0) not in sur._samples and ("g19", 0) in sur._samples
+
+    def test_state_round_trip(self):
+        sur = _trained_surrogate()
+        clone = FitnessSurrogate()
+        clone.load_state_dict(json.loads(json.dumps(sur.state_dict())))
+        g = _genes([1, 1, 0] * 4)
+        assert clone.score(g) == sur.score(g)
+        assert clone.n_samples == sur.n_samples
+        assert clone.refits == sur.refits
+
+
+class TestSurrogateGate:
+    def _gate(self, **kw):
+        kw.setdefault("surrogate", _trained_surrogate())
+        kw.setdefault("eta", 4)
+        kw.setdefault("window", 16)
+        kw.setdefault("min_window", 8)
+        gate = SurrogateGate(**kw)
+        gate.prepare(_genes([0] * 12), maximize=True)
+        return gate
+
+    def test_eta_validation(self):
+        with pytest.raises(ValueError, match="eta"):
+            SurrogateGate(eta=1)
+
+    def test_admit_all_until_trained(self):
+        gate = self._gate(surrogate=FitnessSurrogate(min_train=32))
+        rng = np.random.default_rng(4)
+        decisions = [gate.decide(_rand_genes(rng)) for _ in range(10)]
+        assert all(admit for admit, _ in decisions)
+        assert all(score is None for _, score in decisions)
+
+    def test_quantile_cut_rejects_poor_children(self):
+        gate = self._gate()
+        for bits in range(8, 12):  # fill the window with strong scores
+            for _ in range(4):
+                gate.decide(_genes([1] * bits + [0] * (12 - bits)))
+        admit, score = gate.decide(_genes([0] * 12))
+        assert not admit and score is not None
+        admit, _ = gate.decide(_genes([1] * 12))
+        assert admit
+
+    def test_reject_streak_force_admits(self):
+        gate = self._gate(max_reject_streak=3)
+        for bits in range(8, 12):
+            for _ in range(4):
+                gate.decide(_genes([1] * bits + [0] * (12 - bits)))
+        bad = _genes([0] * 12)
+        outcomes = [gate.decide(bad)[0] for _ in range(6)]
+        assert outcomes[:2] == [False, False]
+        assert True in outcomes[2:]  # the cap let one through
+
+    def test_decide_is_deterministic(self):
+        a, b = self._gate(), self._gate()
+        rng_a, rng_b = np.random.default_rng(5), np.random.default_rng(5)
+        for _ in range(40):
+            assert a.decide(_rand_genes(rng_a)) == b.decide(_rand_genes(rng_b))
+        assert (a.admitted, a.rejected) == (b.admitted, b.rejected)
+
+    def test_pending_resolves_into_precision(self):
+        gate = self._gate()
+        rng = np.random.default_rng(6)
+        admitted = []
+        for _ in range(40):
+            g = _rand_genes(rng)
+            admit, _ = gate.decide(g)
+            if admit:
+                admitted.append(g)
+        for g in admitted:
+            gate.observe_result(g, 0, float(sum(sum(v) for v in g.values())))
+        assert not gate._pending
+        assert gate.precision_at_k is not None
+        assert 0.0 <= gate.precision_at_k <= 1.0
+
+    def test_forget_drops_pending(self):
+        gate = self._gate()
+        g = _genes([1] * 12)
+        gate.decide(g)
+        assert gate._pending
+        gate.forget(g)
+        assert not gate._pending
+
+    def test_counters_and_sampled_histogram(self):
+        spans_mod.enable()
+        gate = self._gate()
+        rng = np.random.default_rng(7)
+        n = 64
+        for _ in range(n):
+            gate.decide(_rand_genes(rng))
+        reg = get_registry()
+        total = (reg.counter("surrogate_gate_admitted_total").value
+                 + reg.counter("surrogate_gate_rejected_total").value)
+        assert total == n == gate.admitted + gate.rejected
+        hist = reg.histogram("surrogate_score_seconds")
+        # Latency is sampled 1-in-(mask+1), not per decide.
+        assert hist.count == n // (SurrogateGate._SAMPLE_MASK + 1)
+
+    def test_state_round_trip_with_pending(self):
+        gate = self._gate()
+        rng = np.random.default_rng(8)
+        for _ in range(20):
+            gate.decide(_rand_genes(rng))
+        assert gate._pending
+        state = json.loads(json.dumps(gate.state_dict()))
+        clone = SurrogateGate.from_state(state)
+        assert clone._pending == gate._pending
+        assert clone._sorted == gate._sorted
+        assert (clone.admitted, clone.rejected) == (gate.admitted, gate.rejected)
+        g = _rand_genes(np.random.default_rng(9))
+        assert clone.decide(g) == gate.decide(g)
+
+
+class TestDatasetPlane:
+    def test_warm_start_trains_from_service_rows(self):
+        rng = np.random.default_rng(10)
+        rows = []
+        for i in range(20):
+            g = _rand_genes(rng)
+            rows.append({"genome": f"g{i}",
+                         "genes": {k: list(v) for k, v in g.items()},
+                         "rung": 0,
+                         "fitness": float(sum(sum(v) for v in g.values()))})
+        gate = SurrogateGate(FitnessSurrogate(min_train=16),
+                             dataset_client=_FakeDatasetClient(rows=rows))
+        gate.prepare(_genes([0] * 12), maximize=True)
+        assert gate.surrogate.trained
+        assert not gate.degraded
+
+    def test_refit_boundary_publishes_rows(self):
+        client = _FakeDatasetClient()
+        gate = SurrogateGate(FitnessSurrogate(min_train=4, refit_every=4),
+                             dataset_client=client)
+        gate.prepare(_genes([0] * 12), maximize=True)
+        rng = np.random.default_rng(11)
+        for _ in range(8):
+            g = _rand_genes(rng)
+            gate.observe_result(g, 0, float(sum(sum(v) for v in g.values())))
+        assert client.published  # synced at the refit boundary
+        assert not gate._publish_buf
+
+    def test_degradation_is_one_event_and_fail_open(self):
+        class _ListSink:
+            def __init__(self):
+                self.records = []
+
+            def record(self, rec):
+                self.records.append(rec)
+
+        spans_mod.enable()
+        sink = _ListSink()
+        spans_mod.set_run_sink(sink)
+        try:
+            client = _FakeDatasetClient(fail=True)
+            gate = SurrogateGate(_trained_surrogate(min_train=4, refit_every=4),
+                                 eta=4, window=16, min_window=8,
+                                 dataset_client=client)
+            gate.prepare(_genes([0] * 12), maximize=True)
+            assert gate.degraded  # warm-start fetch already failed
+            rng = np.random.default_rng(12)
+            for _ in range(12):  # several refit boundaries, all failing
+                g = _rand_genes(rng)
+                gate.observe_result(g, 0, 1.0)
+            assert gate.degraded_total == 1
+            # Degraded ⇒ admit-all, even for children the cut would veto.
+            for bits in range(8, 12):
+                gate.decide(_genes([1] * bits + [0] * (12 - bits)))
+            assert gate.decide(_genes([0] * 12))[0]
+            events = [r for r in sink.records if r.get("type") == "event"
+                      and r.get("name") == "surrogate_degraded"]
+            assert len(events) == 1
+        finally:
+            spans_mod.set_run_sink(None)
+
+    def test_recovery_on_successful_sync(self):
+        client = _FakeDatasetClient(fail=True)
+        gate = SurrogateGate(FitnessSurrogate(min_train=4, refit_every=4),
+                             dataset_client=client)
+        gate.prepare(_genes([0] * 12), maximize=True)
+        assert gate.degraded
+        client.fail = False
+        rng = np.random.default_rng(13)
+        for _ in range(8):
+            g = _rand_genes(rng)
+            gate.observe_result(g, 0, float(sum(sum(v) for v in g.values())))
+        assert not gate.degraded
+        assert gate.degraded_total == 1
+
+
+def _gated(seed=11, **gate_kw):
+    gate_kw.setdefault("surrogate", FitnessSurrogate(min_train=8, refit_every=8))
+    gate_kw.setdefault("eta", 4)
+    gate_kw.setdefault("window", 32)
+    gate_kw.setdefault("min_window", 8)
+    gate = SurrogateGate(**gate_kw)
+    eng = AsyncEvolution(_pop(seed=seed), max_in_flight=1, seed=seed,
+                         surrogate=gate, checkpoint_every=2)
+    return eng, gate
+
+
+def _sig(eng):
+    return [(h["fitness"], h.get("rung")) for h in eng.history]
+
+
+class TestEngineIntegration:
+    def test_off_path_unchanged(self, tmp_path):
+        """surrogate=None: deterministic, and the checkpoint carries no
+        surrogate key at all (the off-path wire/disk format is
+        byte-compatible with an engine that predates the gate)."""
+        path = str(tmp_path / "ck.json")
+        a = AsyncEvolution(_pop(), max_in_flight=1, seed=5, checkpoint_every=4)
+        a.run(max_evaluations=20, checkpointer=Checkpointer(path))
+        b = AsyncEvolution(_pop(), max_in_flight=1, seed=5)
+        b.run(max_evaluations=20)
+        assert _sig(a) == _sig(b)
+        state = json.load(open(path))
+        assert "surrogate" not in state
+        assert "surrogate" not in a._ops_status()
+
+    def test_gated_run_deterministic_and_rejects_rebreed(self):
+        ea, ga = _gated()
+        ea.run(max_evaluations=40)
+        eb, gb = _gated()
+        eb.run(max_evaluations=40)
+        assert _sig(ea) == _sig(eb)
+        assert (ga.admitted, ga.rejected) == (gb.admitted, gb.rejected)
+        assert ga.rejected > 0  # the gate actually vetoed children
+        assert ea.completed == 40  # rejections never consumed budget
+
+    def test_checkpoint_v4_carries_surrogate_and_pending(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        eng, gate = _gated()
+        eng.run(max_evaluations=40, checkpointer=Checkpointer(path))
+        state = json.load(open(path))
+        assert state["schema_version"] == CHECKPOINT_SCHEMA == 4
+        sur = state["surrogate"]
+        assert sur["model"]["weights"] is not None
+        assert sur["scores"]
+        assert isinstance(sur["pending"], list)
+
+    def test_kill_resume_bit_identical(self, tmp_path):
+        ref, _ = _gated()
+        ref.run(max_evaluations=40)
+        resumed_ok = False
+        for at in range(2, 16):
+            path = str(tmp_path / f"ck-{at}.json")
+            eng, _ = _gated()
+            eng.set_fault_injector(FaultInjector(FaultPlan([
+                FaultSpec(hook="master_boundary", kind="kill_master", at=at)])))
+            with pytest.raises(MasterKilled):
+                eng.run(max_evaluations=40, checkpointer=Checkpointer(path))
+            state = json.load(open(path))
+            if not (state.get("surrogate") or {}).get("pending"):
+                continue
+            eng2, _ = _gated()
+            eng2.run(max_evaluations=40, checkpointer=Checkpointer(path))
+            assert _sig(eng2) == _sig(ref)
+            resumed_ok = True
+            break
+        assert resumed_ok, "no kill boundary carried pending gate decisions"
+
+    def test_resume_reconstructs_gate_without_ctor_surrogate(self, tmp_path):
+        """The checkpoint wins (ladder precedent): resuming WITHOUT a
+        ctor surrogate rebuilds the gate from checkpoint state."""
+        path = str(tmp_path / "ck.json")
+        eng, gate = _gated()
+        eng.set_fault_injector(FaultInjector(FaultPlan([
+            FaultSpec(hook="master_boundary", kind="kill_master", at=4)])))
+        with pytest.raises(MasterKilled):
+            eng.run(max_evaluations=40, checkpointer=Checkpointer(path))
+        ref, _ = _gated()
+        ref.run(max_evaluations=40)
+        eng2 = AsyncEvolution(_pop(), max_in_flight=1, seed=11,
+                              checkpoint_every=2)
+        eng2.run(max_evaluations=40, checkpointer=Checkpointer(path))
+        assert eng2._surrogate is not None
+        assert _sig(eng2) == _sig(ref)
+
+    def test_v3_checkpoint_still_loads(self, tmp_path):
+        """Forward compat: a pre-surrogate (v3) checkpoint — no
+        ``surrogate`` key — resumes cleanly; the ctor's gate starts
+        fresh from its own state."""
+        path = str(tmp_path / "ck.json")
+        eng = AsyncEvolution(_pop(), max_in_flight=1, seed=5,
+                             checkpoint_every=4)
+        eng.set_fault_injector(FaultInjector(FaultPlan([
+            FaultSpec(hook="master_boundary", kind="kill_master", at=2)])))
+        with pytest.raises(MasterKilled):
+            eng.run(max_evaluations=24, checkpointer=Checkpointer(path))
+        state = json.load(open(path))
+        state["schema_version"] = 3
+        state.pop("surrogate", None)
+        json.dump(state, open(path, "w"))
+        eng2 = AsyncEvolution(_pop(), max_in_flight=1, seed=5)
+        eng2.run(max_evaluations=24, checkpointer=Checkpointer(path))
+        assert eng2.completed == 24
+
+    def test_newer_schema_refused(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        json.dump({"schema_version": 5}, open(path, "w"))
+        with pytest.raises(ValueError, match="newer"):
+            Checkpointer(path).load()
+
+    def test_gate_status_in_ops_status(self):
+        eng, gate = _gated()
+        eng.run(max_evaluations=24)
+        status = eng._ops_status()["surrogate"]
+        assert status["admitted"] == gate.admitted
+        assert status["trained"] is True
