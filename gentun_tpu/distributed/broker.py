@@ -45,6 +45,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Set
 
+from ..parallel.mesh import job_size_class
 from ..telemetry import health as _health
 from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
@@ -993,6 +994,17 @@ class JobBroker:
                 w.credit -= 1
                 w.in_flight.add(job_id)
                 inflight[sid] = inflight.get(sid, 0) + 1
+                # Size-class dispatch accounting (big-genome regime,
+                # docs/OBSERVABILITY.md): one labeled counter bump per
+                # handoff.  job_size_class is jax-free integer math on the
+                # payload config — its cost share of a dispatch is gated
+                # at <= 2% by scripts/broker_throughput.py.
+                _get_registry().counter(
+                    "jobs_dispatched_total",
+                    genome_size_class=job_size_class(
+                        self._payloads[job_id].get("additional_parameters"),
+                        int((w.mesh or {}).get("devices") or 1)),
+                ).inc()
                 if tele:
                     # queue_wait: time from (re)enqueue to handoff.  The
                     # stamp stays in place — _on_result uses it for the

@@ -102,6 +102,11 @@ class GentunClient:
       derives from (default ``jax.device_count()``).  For tests and chaos
       drills — jax cannot simulate gaining or losing a device in-process —
       and for non-jax species that want mesh-derived windows anyway.
+    - ``mesh_override``: pin the ``(pop, data)`` factoring instead of the
+      heuristic — a ``"POPxDATA"`` string (the worker's ``--mesh`` flag)
+      or a tuple.  Malformed or non-factoring values raise ``ValueError``
+      at the point the device count is known, and :meth:`remesh`
+      re-validates against the post-change count.
     - ``prefetch_depth``: jobs queued locally BEYOND ``capacity`` so the
       next window is already decoded when the current one finishes
       (double buffering — a background receive thread feeds a local
@@ -148,6 +153,7 @@ class GentunClient:
         capacity=1,
         prefetch_depth: Optional[int] = None,
         mesh_devices: Optional[int] = None,
+        mesh_override=None,
         heartbeat_interval: float = 3.0,
         reconnect_delay: float = 1.0,
         reconnect_max_delay: float = 30.0,
@@ -172,6 +178,23 @@ class GentunClient:
         # compiled batch shape).
         self._mesh_shape: Optional[tuple] = None  # (pop, data) axis sizes
         self._mesh_devices: Optional[int] = None
+        # Operator mesh override (worker ``--mesh POPxDATA``): pins the
+        # (pop, data) factoring instead of the heuristic.  Accepted as a
+        # "POPxDATA" string or a (pop, data) tuple; malformed values raise
+        # ValueError here (the worker CLI converts to SystemExit).  The
+        # override is installed process-wide (``parallel.mesh
+        # .set_mesh_override``) so the evaluator's ``auto_mesh`` honors it
+        # without touching the wire config — cache keys and fitness
+        # fingerprints stay unchanged — and it is re-validated against the
+        # live device count on every capacity derivation (join, remesh).
+        self._mesh_override: Optional[tuple] = None
+        if mesh_override is not None:
+            from ..parallel.mesh import parse_mesh_spec, set_mesh_override
+
+            if isinstance(mesh_override, str):
+                mesh_override = parse_mesh_spec(mesh_override)
+            self._mesh_override = (int(mesh_override[0]), int(mesh_override[1]))
+            set_mesh_override(self._mesh_override)  # validates positivity
         self._mesh_auto = isinstance(capacity, str)
         if self._mesh_auto:
             if str(capacity).strip().lower() != "auto":
@@ -301,7 +324,9 @@ class GentunClient:
             import jax  # the fitness path initializes this backend anyway
 
             n_devices = max(1, int(jax.device_count()))
-        capacity, pop_axis, data_axis = host_worker_capacity(n_devices)
+        pop_o, data_o = self._mesh_override or (None, None)
+        capacity, pop_axis, data_axis = host_worker_capacity(
+            n_devices, pop_axis=pop_o, data_axis=data_o)
         self._mesh_devices = int(n_devices)
         self._mesh_shape = (pop_axis, data_axis)
         reg = _get_registry()
@@ -595,6 +620,14 @@ class GentunClient:
                            "data": self._mesh_shape[1],
                            "devices": self._mesh_devices,
                            "derived_capacity": self._mesh_auto}
+        # Padding-waste split (big-genome regime): slots trained and sliced
+        # away on the pop axis vs batch lanes GSPMD pads on the data axis —
+        # the two ways a misaligned schedule burns device time.
+        _reg = _get_registry()
+        out["pad_waste"] = {
+            "pop": _reg.counter("eval_pad_waste_total").value,
+            "data": _reg.counter("eval_data_pad_waste_total").value,
+        }
         if self._cache_client is not None:
             out["fitness_service"] = self._cache_client.stats()
         if self._compile_client is not None:
@@ -826,12 +859,36 @@ class GentunClient:
         off-multiple — it buckets and pads exactly as a small generation
         tail always has.  Per-chip workers (integer capacity, no mesh)
         keep the historical capacity-sized chunking bit-for-bit.
+
+        Big-genome regime: jobs are first partitioned by size class
+        (``parallel.mesh.job_size_class`` on the wire config — jax-free,
+        micro-gated) so a window never mixes mesh shapes.  Small jobs keep
+        the windowed chunking above; big/micro jobs get the per-class
+        window ``host_worker_capacity`` derives for them — exactly 1, one
+        genome per ``(1, n_devices)`` data-sharded program — and are
+        emitted AFTER the small windows so each frame flips the mesh shape
+        at most once (``mesh_reshapes_total``).  With no ``device_budget``
+        in any job's config every job classifies small and the historical
+        chunking is bit-for-bit unchanged.
         """
+        from ..parallel.mesh import SIZE_SMALL, job_size_class
+
+        n_dev = self._mesh_devices or 1
+        small = []
+        narrow = []
+        for job in jobs:
+            params = job.get("additional_parameters") if isinstance(job, dict) else None
+            if job_size_class(params, n_dev) == SIZE_SMALL:
+                small.append(job)
+            else:
+                narrow.append([job])
         step = self.capacity
         pop = self._mesh_shape[0] if self._mesh_shape else 1
         if pop > 1 and step % pop:
             step = max(pop, step - step % pop)
-        return [jobs[i:i + step] for i in range(0, len(jobs), step)]
+        chunks = [small[i:i + step] for i in range(0, len(small), step)]
+        chunks.extend(narrow)
+        return chunks
 
     def _await_jobs(self) -> List[Dict[str, Any]]:
         while True:

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Type
 import numpy as np
 
 from ..individuals import Individual
+from ..parallel.mesh import SIZE_SMALL, job_size_class
 from ..populations import GridPopulation, Population
 from ..telemetry import health as _health
 from ..telemetry import lineage as _lineage
@@ -307,7 +308,21 @@ class DistributedPopulation(Population):
         trainings whose fitnesses seed the cache, instead of sliced-away
         waste (``eval_pad_waste_total``).  Fleets with no mesh workers
         get the base bucket target unchanged.
+
+        Big-genome regime: the rounding is per size class.  Non-small
+        configs (``parallel.mesh.job_size_class`` on the evaluation
+        params — jax-free) run ONE genome per program on the narrow-pop
+        ``(1, n)`` mesh, so there is no pop multiple to align to and no
+        compile bucket to fill — speculative padding would train extra
+        over-budget genomes at full price for nothing.  They keep the
+        exact real count (plus only an EXPLICIT integer
+        ``speculative_fill``, which remains an operator decision).
         """
+        if job_size_class(params) != SIZE_SMALL:
+            target = int(n_real)
+            if self.speculative_fill is not True and self.speculative_fill:
+                target = max(target, int(self.speculative_fill))
+            return target
         target = super()._fill_target(n_real, params)
         multiple = self.broker.fleet_mesh_pop()
         if multiple > 1 and target % multiple:

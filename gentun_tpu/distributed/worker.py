@@ -136,6 +136,14 @@ def main(argv=None) -> int:
                          "its capacity from the mesh (compile bucket x "
                          "pop-axis size) instead of a typed-in number — "
                          "see DISTRIBUTED.md 'Host-level mesh workers'")
+    ap.add_argument("--mesh", default=None, metavar="POPxDATA",
+                    help="pin the (pop, data) device-mesh factoring instead "
+                         "of auto_mesh's heuristic, e.g. --mesh 4x2 on an "
+                         "8-device host.  The axes must multiply to the "
+                         "local device count (checked when the count is "
+                         "known, and re-checked on remesh); malformed or "
+                         "non-factoring values exit loudly.  See "
+                         "DISTRIBUTED.md 'Big-genome regime'.")
     ap.add_argument("--prefetch-depth", type=int, default=None,
                     help="jobs queued locally BEYOND capacity so the next "
                          "window is decoded while the current one trains "
@@ -224,6 +232,13 @@ def main(argv=None) -> int:
                 f"--capacity must be a positive integer or 'auto', got {args.capacity!r}")
         if args.capacity <= 0:
             raise SystemExit(f"--capacity must be a positive integer, got {args.capacity}")
+    if args.mesh is not None:
+        from ..parallel.mesh import parse_mesh_spec
+
+        try:
+            args.mesh = parse_mesh_spec(args.mesh)
+        except ValueError as e:
+            raise SystemExit(f"--mesh: {e}")
     if args.prefetch_depth is not None and args.prefetch_depth < 0:
         raise SystemExit(f"--prefetch-depth must be >= 0, got {args.prefetch_depth}")
     if args.ops_port is not None and not 0 <= args.ops_port <= 65535:
@@ -297,23 +312,31 @@ def main(argv=None) -> int:
             "fault injection ACTIVE: %d spec(s) from %s", len(injector.plan.specs), args.fault_plan
         )
 
-    client = GentunClient(
-        _species(args.species),
-        x,
-        y,
-        host=args.host,
-        port=args.port,
-        password=args.password,
-        capacity=args.capacity,
-        prefetch_depth=args.prefetch_depth,
-        worker_id=args.worker_id,
-        multihost=multihost,
-        n_chips=args.n_chips,
-        fitness_store=args.fitness_store,
-        cache_url=args.cache_url,
-        compile_cache_url=args.compile_cache_url,
-        fault_injector=injector,
-    )
+    try:
+        client = GentunClient(
+            _species(args.species),
+            x,
+            y,
+            host=args.host,
+            port=args.port,
+            password=args.password,
+            capacity=args.capacity,
+            prefetch_depth=args.prefetch_depth,
+            mesh_override=args.mesh,
+            worker_id=args.worker_id,
+            multihost=multihost,
+            n_chips=args.n_chips,
+            fitness_store=args.fitness_store,
+            cache_url=args.cache_url,
+            compile_cache_url=args.compile_cache_url,
+            fault_injector=injector,
+        )
+    except ValueError as e:
+        # Config errors the CLI could not pre-validate — notably a --mesh
+        # override that does not factor the probed device count (only
+        # known here, after any multihost init).  Exit loudly instead of
+        # surfacing a traceback.
+        raise SystemExit(str(e))
     # Elastic-fleet exit protocol (DISTRIBUTED.md "Elastic fleet"): first
     # SIGTERM/SIGINT asks for an orderly drain — finish the window being
     # trained, hand queued-but-unstarted jobs back to the broker, exit.  A

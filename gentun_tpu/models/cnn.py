@@ -63,7 +63,16 @@ import flax.linen as nn
 import optax
 
 from ..ops.dag import stack_genome_masks
-from ..parallel.mesh import auto_mesh, mesh_axis_sizes, pad_population, pop_bucket, shard_cv_args
+from ..parallel.mesh import (
+    SIZE_SMALL,
+    auto_mesh,
+    classify_genome_cost,
+    cnn_genome_cost,
+    mesh_axis_sizes,
+    pad_population,
+    pop_bucket,
+    shard_cv_args,
+)
 from ..parallel.multihost import fetch, place, place_tree
 from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
@@ -184,6 +193,7 @@ def _training_primitives(
     n_val_padded: int,
     stage_exit_conv: bool,
     eval_batch_size: int,
+    microbatch: int = 1,
 ):
     """Shared, unjitted builders both executors compose: the model, the
     optimizer (staged-LR SGD), a train-segment function, and the fold eval.
@@ -192,6 +202,17 @@ def _training_primitives(
     forward-only (no optimizer state, no activations kept for backward), so
     larger batches amortise per-batch overhead and widen the MXU work with
     no memory downside.
+
+    ``microbatch > 1`` (big-genome regime, DISTRIBUTED.md) splits each
+    optimizer step's batch into that many slices and accumulates their
+    gradients with an inner scan before the ONE optimizer update, cutting
+    peak backward-pass activations by the same factor while keeping the
+    step count, the LR schedule position, and the gradient expectation
+    unchanged (mean of slice means = full-batch mean; dropout draws differ
+    because the mask shape follows the slice).  ``microbatch=1`` traces
+    the exact pre-existing step — the ``if`` below is Python-level, so the
+    compiled program (and its persistent-cache key) is byte-identical to
+    before the knob existed.
 
     There is exactly ONE definition of the schedule-boundary math, the loss,
     and the eval weighting — the fused (:func:`_population_cv_fn`) and
@@ -237,9 +258,23 @@ def _training_primitives(
         def step(carry, idx_b):
             params, opt_state, rng = carry
             rng, dropout_rng = jax.random.split(rng)
-            batch_x = jnp.take(x_full, idx_b, axis=0)
-            batch_y = jnp.take(y_full, idx_b, axis=0)
-            _, grads = jax.value_and_grad(loss_fn)(params, masks, batch_x, batch_y, dropout_rng)
+            if microbatch > 1:
+                idx_m = idx_b.reshape(microbatch, batch_size // microbatch)
+
+                def micro(acc, im):
+                    bx = jnp.take(x_full, im, axis=0)
+                    by = jnp.take(y_full, im, axis=0)
+                    _, g = jax.value_and_grad(loss_fn)(params, masks, bx, by, dropout_rng)
+                    return jax.tree.map(jnp.add, acc, g), None
+
+                grads, _ = jax.lax.scan(
+                    micro, jax.tree.map(jnp.zeros_like, params), idx_m
+                )
+                grads = jax.tree.map(lambda g: g / microbatch, grads)
+            else:
+                batch_x = jnp.take(x_full, idx_b, axis=0)
+                batch_y = jnp.take(y_full, idx_b, axis=0)
+                _, grads = jax.value_and_grad(loss_fn)(params, masks, batch_x, batch_y, dropout_rng)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state, rng), None
@@ -366,6 +401,7 @@ def _static_key(cfg: Dict[str, Any], batch_size: int, n_train: int, n_val_padded
         n_val_padded,
         bool(cfg["stage_exit_conv"]),
         eval_batch_size,
+        int(cfg.get("microbatch", 1) or 1),
     )
 
 
@@ -808,6 +844,7 @@ def _oom_cap_key(cfg: Dict[str, Any]):
         bool(cfg["fold_parallel"]),
         cfg["segment_steps"],
         int(cfg["kfold"]) if cfg.get("kfold") else None,
+        int(cfg.get("microbatch", 1) or 1),
     )
 
 
@@ -904,6 +941,75 @@ def _chunked_by_cap(run, genomes, cap_key, run_exact=None):
 _pop_bucket = pop_bucket
 
 
+def _genome_size_class(cfg: Dict[str, Any]) -> Tuple[str, int]:
+    """(size_class, microbatch) for this config against its device budget.
+
+    The evaluator-side classification (big-genome regime, DISTRIBUTED.md):
+    same cost model the dispatch plane's ``job_size_class`` consults, but
+    LOUD — an unevaluable genome raises here with full context instead of
+    degrading, because this is the process that would otherwise OOM.  The
+    class is a property of the CONFIG (the supergraph runs every node conv
+    regardless of mask bits), so one evaluation batch has exactly one
+    class.  No budget configured → the wide-pop path, bit-identically.
+    """
+    budget = cfg.get("device_budget")
+    if not budget:
+        return SIZE_SMALL, 1
+    cost = cnn_genome_cost(
+        cfg["nodes"],
+        cfg["kernels_per_layer"],
+        cfg["input_shape"],
+        cfg["dense_units"],
+        cfg["n_classes"],
+        cfg["compute_dtype"],
+        bool(cfg["stage_exit_conv"]),
+    )
+    return classify_genome_cost(
+        cost, int(cfg["batch_size"]), jax.device_count(), int(budget)
+    )
+
+
+def _account_sharded_batch(cfg: Dict[str, Any], mesh, batch_size: int, steps: int) -> None:
+    """Fit the microbatch factor to the ACTUAL step batch and account waste.
+
+    Called by both evaluators once the clamped ``batch_size`` is known
+    (small folds can shrink it below ``cfg['batch_size']``), BEFORE the
+    static key is read:
+
+    - ``cfg['microbatch']`` is clamped to the batch and bumped to the next
+      divisor, so the accumulation reshape is always exact;
+    - ``microbatch_steps_total`` counts the micro-gradient passes this
+      evaluation will run (train steps × factor) whenever accumulation is
+      active;
+    - ``eval_data_pad_waste_total`` counts batch slots the data axis pads
+      per step (GSPMD pads uneven shards internally; those lanes are
+      wasted work exactly like pop-padding slots), summed over the
+      evaluation's steps — the data-axis sibling of
+      ``eval_pad_waste_total``, surfaced next to it in ``/statusz``.
+    """
+    micro = int(cfg.get("microbatch", 1) or 1)
+    if micro > 1:
+        micro = min(micro, batch_size)
+        while batch_size % micro:
+            micro += 1
+        cfg["microbatch"] = micro
+        _get_registry().counter("microbatch_steps_total").inc(steps * micro)
+    _, data_ax = mesh_axis_sizes(mesh)
+    shard_rem = batch_size % data_ax
+    if shard_rem:
+        _get_registry().counter("eval_data_pad_waste_total").inc(
+            (data_ax - shard_rem) * steps
+        )
+
+
+#: Mesh shape of the previous evaluation in this process — feeds the
+#: ``mesh_reshapes_total`` counter (docs/OBSERVABILITY.md): every flip is
+#: a sharding layout change, and interleaving size classes carelessly
+#: shows up here as churn the dispatch plane's class-grouping should have
+#: prevented.
+_LAST_MESH_SHAPE: Optional[Tuple[int, int]] = None
+
+
 def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str, Any]]):
     """Shared entry-point setup: enable the persistent compilation cache,
     resolve the mesh, pad the population to the compile-shape bucket and
@@ -941,9 +1047,10 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     # size would give different small batches different mesh factorings
     # (and therefore fresh compiles) even though they pad to one shape.
     target = _pop_bucket(len(genomes)) if cfg["pop_padding"] else len(genomes)
+    size_class, _ = _genome_size_class(cfg)
     mesh = cfg["mesh"]
     if mesh == "auto":
-        mesh = auto_mesh(pop_size=target)
+        mesh = auto_mesh(pop_size=target, size_class=size_class)
     multiple = mesh.shape["pop"] if mesh else 1
     if cfg["pop_padding"]:
         # honor the mesh multiple on top of the bucket
@@ -964,6 +1071,10 @@ def _prepare_population_setup(cfg: Dict[str, Any], genomes: Sequence[Mapping[str
     _pop_ax, _data_ax = mesh_axis_sizes(mesh)
     _reg.gauge("mesh_pop_axis").set(_pop_ax)
     _reg.gauge("mesh_data_axis").set(_data_ax)
+    global _LAST_MESH_SHAPE
+    if _LAST_MESH_SHAPE is not None and (_pop_ax, _data_ax) != _LAST_MESH_SHAPE:
+        _reg.counter("mesh_reshapes_total").inc()
+    _LAST_MESH_SHAPE = (_pop_ax, _data_ax)
     if len(genomes) > n_real:
         _reg.counter("eval_pad_waste_total").inc(len(genomes) - n_real)
     stacked = [
@@ -1005,7 +1116,13 @@ class GeneticCnnModel(GentunModel):
     conv — measured at the full schedule on two workloads, the bare-sum
     default matched or beat it on CV and holdout accuracy, so False stays
     the default (docs/STAGE_EXIT_CONV.md has the table); ``mesh``/
-    ``cache_dir`` control sharding and the persistent compilation cache.
+    ``cache_dir`` control sharding and the persistent compilation cache;
+    ``device_budget`` (bytes per device, default off) turns on the
+    big-genome regime — configs whose cost model exceeds it leave the
+    wide-pop vmap path for a narrow-pop data-sharded mesh, with
+    ``microbatch`` gradient accumulation when even a full-data-axis batch
+    shard oversubscribes (DISTRIBUTED.md "Big-genome regime";
+    ``microbatch`` may also be set directly).
 
     Data contract: ``x_train``/``y_train`` are treated as immutable — the
     permuted dataset is cached on device across ``evaluate()`` calls, keyed
@@ -1041,6 +1158,8 @@ class GeneticCnnModel(GentunModel):
         pop_padding: bool = True,
         fitness_reps: int = 1,
         entry_channel_pad: Optional[int] = None,
+        device_budget: Optional[int] = None,
+        microbatch: int = 1,
     ):
         super().__init__(x_train, y_train, genes)
         self.config = dict(
@@ -1066,6 +1185,8 @@ class GeneticCnnModel(GentunModel):
             pop_padding=bool(pop_padding),
             fitness_reps=int(fitness_reps),
             entry_channel_pad=entry_channel_pad,
+            device_budget=device_budget,
+            microbatch=int(microbatch),
         )
 
     def cross_validate(self) -> float:
@@ -1117,6 +1238,23 @@ class GeneticCnnModel(GentunModel):
             ]
             return np.mean(per_rep, axis=0, dtype=np.float64).astype(np.float32)
         cfg0 = _normalize_config(x_train, y_train, config)
+        size_class, micro = _genome_size_class(cfg0)
+        if size_class != SIZE_SMALL:
+            # Big-genome regime: the cost model says the wide-pop vmap
+            # cannot fit, so run ONE genome per program on the narrow-pop
+            # (1, n_devices) mesh with the batch sharded across the full
+            # data axis (pop_padding off: the 1-wide exact program IS the
+            # intended shape here, not an OOM fallback).  No
+            # _chunked_by_cap — its pop-splitting cannot help a program
+            # that is already 1 genome wide.
+            sub = {**config, "pop_padding": False, "microbatch": micro}
+            outs = [
+                cls._cross_validate_population_one(x_train, y_train, [g], **sub)
+                for g in genomes
+            ]
+            return (
+                np.concatenate(outs) if outs else np.zeros((0,), dtype=np.float32)
+            )
         return _chunked_by_cap(
             lambda gs: cls._cross_validate_population_one(x_train, y_train, gs, **config),
             list(genomes),
@@ -1161,6 +1299,7 @@ class GeneticCnnModel(GentunModel):
         total_steps = sum(cfg["epochs"]) * steps_per_epoch
         eval_bs, n_val_padded = _eval_batch_size(batch_size, fold_size)
         pad = n_val_padded - fold_size
+        _account_sharded_batch(cfg, mesh, batch_size, total_steps * kfold)
 
         # Per-fold index arrays (host-side numpy, tiny): the fold IS its
         # indices.  batch_idx holds *global* dataset indices, so the compiled
@@ -1278,6 +1417,17 @@ class GeneticCnnModel(GentunModel):
             ]
             return np.mean(per_rep, axis=0, dtype=np.float64).astype(np.float32)
         cfg0 = _normalize_config(x_train, y_train, config)
+        size_class, micro = _genome_size_class(cfg0)
+        if size_class != SIZE_SMALL:
+            # Same big-genome routing as cross_validate_population.
+            sub = {**config, "pop_padding": False, "microbatch": micro}
+            outs = [
+                cls._train_and_score_one(x_train, y_train, x_test, y_test, [g], **sub)
+                for g in genomes
+            ]
+            return (
+                np.concatenate(outs) if outs else np.zeros((0,), dtype=np.float32)
+            )
         return _chunked_by_cap(
             lambda gs: cls._train_and_score_one(x_train, y_train, x_test, y_test, gs, **config),
             list(genomes),
@@ -1321,6 +1471,7 @@ class GeneticCnnModel(GentunModel):
         total_steps = sum(cfg["epochs"]) * steps_per_epoch
         eval_bs, n_val_padded = _eval_batch_size(batch_size, n_te)
         pad = n_val_padded - n_te
+        _account_sharded_batch(cfg, mesh, batch_size, total_steps)
 
         rng = np.random.default_rng(cfg["seed"])
         order = np.concatenate(
@@ -1382,6 +1533,8 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
         fitness_reps=1,
         entry_channel_pad=None,
         warm_start=False,
+        device_budget=None,
+        microbatch=1,
     )
     unknown = set(config) - set(defaults)
     if unknown:
@@ -1403,6 +1556,13 @@ def _normalize_config(x_train, y_train, config: Dict[str, Any]) -> Dict[str, Any
     if cfg["fitness_reps"] < 1:
         raise ValueError("fitness_reps must be a positive int")
     cfg["warm_start"] = bool(cfg["warm_start"])
+    if cfg["device_budget"] is not None:
+        cfg["device_budget"] = int(cfg["device_budget"])
+        if cfg["device_budget"] < 1:
+            raise ValueError("device_budget must be positive bytes or None")
+    cfg["microbatch"] = 1 if cfg["microbatch"] is None else int(cfg["microbatch"])
+    if cfg["microbatch"] < 1:
+        raise ValueError("microbatch must be a positive int")
     if cfg["entry_channel_pad"] is not None:
         cfg["entry_channel_pad"] = int(cfg["entry_channel_pad"])
         if cfg["entry_channel_pad"] < 1:

@@ -379,6 +379,50 @@ def run_surrogate_gate(per_job_dispatch_us: float) -> dict:
     }
 
 
+def run_sizeclass_gate(per_job_dispatch_us: float) -> dict:
+    """Size-aware dispatch cost of the big-genome regime, micro-timed.
+
+    With a ``device_budget`` on the wire, every dispatch classifies the
+    job (``jobs_dispatched_total{genome_size_class=…}``), the worker's
+    ``_chunk_jobs`` classifies each job once more to partition frames by
+    class, and the master's fill target classifies once per breed round —
+    all through ``parallel.mesh.job_size_class``: the full jax-free cost
+    model (stage-DAG params + activations) plus the budget comparison.
+    Same instrument as the forensics/compile/surrogate gates: the
+    steady-state worst case (budget present, all fields populated, class
+    lands ``big`` so no early-out fires) timed directly over batched
+    invocations, divided by the measured per-job dispatch cost."""
+    from gentun_tpu.parallel.mesh import cnn_genome_cost, job_size_class
+
+    cost = cnn_genome_cost((3, 5), (20, 50), (28, 28, 1), 500, 10)
+    wire = {
+        "nodes": (3, 5), "kernels_per_layer": (20, 50),
+        "input_shape": (28, 28, 1), "n_classes": 10, "dense_units": 500,
+        "batch_size": 128, "compute_dtype": "bfloat16",
+        "device_budget": cost.param_bytes + cost.act_bytes_per_example * 32,
+    }
+    assert job_size_class(wire, 8) == "big", "bench config must classify big"
+    batch = [wire] * 2000
+
+    def _loop():
+        for params in batch:
+            job_size_class(params, 8)
+
+    reps, inner = 3, 10
+    t_classify_s = min(timeit.repeat(_loop, number=inner, repeat=reps)) / (
+        inner * len(batch))
+    per_job_added_us = round(t_classify_s * 1e6, 3)
+    overhead_pct = round(per_job_added_us / per_job_dispatch_us * 100.0, 3)
+    return {
+        "classify_us": per_job_added_us,
+        "per_job_added_us": per_job_added_us,
+        "per_job_dispatch_us": per_job_dispatch_us,
+        "overhead_pct": overhead_pct,
+        "gate_max_pct": 2.0,
+        "within_gate": overhead_pct <= 2.0,
+    }
+
+
 def main() -> dict:
     # Single-tenant pass first (the historical headline numbers), then the
     # same workload split across 4 fair-share sessions: the difference is
@@ -434,6 +478,18 @@ def main() -> dict:
         f"{out['surrogate']['overhead_pct']}% exceeds the 2% gate "
         f"({out['surrogate']['per_job_added_us']}us added on "
         f"{out['surrogate']['per_job_dispatch_us']}us/job dispatch)")
+
+    # Big-genome size-class gate (DISTRIBUTED.md "Big-genome regime"):
+    # the per-job cost-model classification the dispatch plane runs when
+    # a device_budget is on the wire must also stay <=2% of per-job
+    # dispatch cost.  Same denominator again.
+    out["sizeclass"] = run_sizeclass_gate(
+        out["forensics"]["per_job_dispatch_us"])
+    assert out["sizeclass"]["within_gate"], (
+        f"size-class classification overhead "
+        f"{out['sizeclass']['overhead_pct']}% exceeds the 2% gate "
+        f"({out['sizeclass']['per_job_added_us']}us added on "
+        f"{out['sizeclass']['per_job_dispatch_us']}us/job dispatch)")
 
     # Informational (not gated): the full per-job accounting fare.  When a
     # master runs full forensics it stamps `fz` into the propagated trace

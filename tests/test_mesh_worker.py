@@ -119,6 +119,89 @@ class TestMeshAlignedChunking:
         c = _client(capacity=3)
         assert [len(ch) for ch in c._chunk_jobs(list(range(8)))] == [3, 3, 2]
 
+    def test_mixed_class_frame_never_mixes(self):
+        """Big-genome regime: a frame mixing small and big jobs is
+        partitioned by size class — small windows first, then each big
+        job as a singleton (its program is 1-wide on a (1, n) mesh), so
+        no chunk ever mixes mesh shapes and the shape flips at most once
+        per frame."""
+        from gentun_tpu.parallel.mesh import (
+            SIZE_SMALL, cnn_genome_cost, job_size_class)
+
+        c = _client(capacity="auto", mesh_devices=8)  # capacity 16, pop 8
+        cost = cnn_genome_cost((3,), (8,), (8, 8, 1), 32, 4, "float32")
+        big_params = dict(
+            nodes=(3,), kernels_per_layer=(8,), input_shape=(8, 8, 1),
+            dense_units=32, n_classes=4, compute_dtype="float32",
+            batch_size=32,
+            device_budget=cost.param_bytes + cost.act_bytes_per_example * 8)
+        jobs = [{"job_id": f"j{i}",
+                 "additional_parameters": big_params if i % 5 == 0 else {}}
+                for i in range(20)]  # 4 big interleaved among 16 small
+        chunks = c._chunk_jobs(jobs)
+        assert [len(ch) for ch in chunks] == [16, 1, 1, 1, 1]
+        for ch in chunks:
+            classes = {job_size_class(j["additional_parameters"], 8) for j in ch}
+            assert len(classes) == 1  # never a mixed frame
+        assert all(job_size_class(j["additional_parameters"], 8) != SIZE_SMALL
+                   for ch in chunks[1:] for j in ch)
+        # every job routed exactly once, order preserved within each class
+        assert sorted(j["job_id"] for ch in chunks for j in ch) == \
+            sorted(j["job_id"] for j in jobs)
+        assert [j["job_id"] for j in chunks[0]] == \
+            [f"j{i}" for i in range(20) if i % 5]
+
+    def test_budget_free_jobs_keep_historical_chunking(self):
+        """Feature off (no device_budget on any wire config): the
+        partitioning is a no-op and chunking stays bit-for-bit the
+        PR-10 mesh-aligned behavior."""
+        c = _client(capacity="auto", mesh_devices=8)
+        jobs = [{"job_id": f"j{i}", "additional_parameters": {}}
+                for i in range(35)]
+        assert [len(ch) for ch in c._chunk_jobs(jobs)] == [16, 16, 3]
+
+
+class TestMeshOverride:
+    """Satellite: the worker-level ``--mesh POPxDATA`` override — loud on
+    anything malformed or non-factoring, re-validated whenever the device
+    count changes (``remesh``), never riding the wire config."""
+
+    @pytest.fixture(autouse=True)
+    def _clear_override(self):
+        from gentun_tpu.parallel.mesh import set_mesh_override
+        yield
+        set_mesh_override(None)
+
+    def test_cli_rejects_malformed_mesh(self):
+        from gentun_tpu.distributed.worker import main as worker_main
+
+        for bad in ("8", "axb", "0x8", "2x2x2"):
+            with pytest.raises(SystemExit, match="--mesh"):
+                worker_main(["--mesh", bad])
+
+    def test_override_shapes_capacity_and_advert(self):
+        from gentun_tpu.parallel.mesh import get_mesh_override
+
+        c = _client(capacity="auto", mesh_devices=8, mesh_override="4x2")
+        assert c._mesh_shape == (4, 2)
+        assert c.capacity == 8  # 2 slots x pop 4
+        # installed process-wide so the evaluator's auto_mesh sees it
+        assert get_mesh_override() == (4, 2)
+
+    def test_non_factoring_override_is_loud(self):
+        with pytest.raises(ValueError, match="factor"):
+            _client(capacity="auto", mesh_devices=8, mesh_override="3x2")
+
+    def test_remesh_revalidates_override(self):
+        # (4, 2) factors 8 devices; after losing 2 devices it factors
+        # nothing — the remesh must refuse rather than advertise a mesh
+        # the evaluator cannot build.
+        c = _client(capacity="auto", mesh_devices=8, mesh_override=(4, 2))
+        with pytest.raises(ValueError, match="factor"):
+            c.remesh(n_devices=6)
+        # the pre-remesh advert state is untouched by the failed attempt
+        assert c._mesh_shape == (4, 2)
+
 
 class TestHostMeshEndToEnd:
     def test_host_worker_advertises_mesh_and_evaluates(self):

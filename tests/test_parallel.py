@@ -12,12 +12,21 @@ import jax
 
 from gentun_tpu.models.cnn import GeneticCnnModel
 from gentun_tpu.parallel.mesh import (
+    SIZE_BIG,
+    SIZE_MICRO,
+    SIZE_SMALL,
     auto_mesh,
+    classify_genome_cost,
+    cnn_genome_cost,
+    get_mesh_override,
     host_worker_capacity,
+    job_size_class,
     mesh_axis_sizes,
     mesh_factor,
     pad_population,
+    parse_mesh_spec,
     pop_bucket,
+    set_mesh_override,
 )
 
 FAST = dict(
@@ -112,6 +121,46 @@ class TestMeshConstruction:
         for n in range(1, 40):
             assert pop_bucket(n) == _pop_bucket(n) == _compile_bucket(n)
 
+    def test_host_worker_capacity_size_class_and_override(self):
+        # big/micro jobs compile 1-wide programs on a (1, n) mesh: the
+        # window is exactly one job, the frame IS the job
+        assert host_worker_capacity(8, size_class=SIZE_BIG) == (1, 1, 8)
+        assert host_worker_capacity(8, size_class=SIZE_MICRO) == (1, 1, 8)
+        # operator --mesh override replaces the heuristic factoring
+        assert host_worker_capacity(8, pop_axis=4, data_axis=2) == (8, 4, 2)
+        # ... and must name both axes, stay positive, and factor the host
+        with pytest.raises(ValueError, match="both"):
+            host_worker_capacity(8, pop_axis=4)
+        with pytest.raises(ValueError, match="positive"):
+            host_worker_capacity(8, pop_axis=0, data_axis=8)
+        with pytest.raises(ValueError, match="factor"):
+            host_worker_capacity(8, pop_axis=3, data_axis=2)
+        with pytest.raises(ValueError, match="size_class"):
+            host_worker_capacity(8, size_class="huge")
+
+    def test_parse_mesh_spec(self):
+        assert parse_mesh_spec("4x2") == (4, 2)
+        assert parse_mesh_spec(" 8X1 ") == (8, 1)  # case/space tolerant
+        for bad in ("8", "8x", "x8", "axb", "0x8", "4x-2", "2x2x2"):
+            with pytest.raises(ValueError):
+                parse_mesh_spec(bad)
+
+    def test_mesh_override_precedence(self):
+        """Worker ``--mesh`` reaches auto_mesh process-wide; explicit axes
+        beat it; a big size class beats everything (the batch must cross
+        the FULL data axis); clearing restores the heuristic."""
+        set_mesh_override((2, 4))
+        try:
+            assert mesh_axis_sizes(auto_mesh(pop_size=16)) == (2, 4)
+            assert mesh_axis_sizes(auto_mesh(pop_axis=4, data_axis=2)) == (4, 2)
+            assert mesh_axis_sizes(auto_mesh(pop_size=16, size_class=SIZE_BIG)) == (1, 8)
+            with pytest.raises(ValueError, match="positive"):
+                set_mesh_override((0, 8))
+        finally:
+            set_mesh_override(None)
+        assert get_mesh_override() is None
+        assert mesh_axis_sizes(auto_mesh(pop_size=16)) == (8, 1)
+
     def test_pad_population(self):
         genomes = [{"S_1": (0, 0, 0)}, {"S_1": (1, 0, 1)}, {"S_1": (1, 1, 1)}]
         padded, n = pad_population(genomes, 4)
@@ -119,6 +168,97 @@ class TestMeshConstruction:
         assert padded[3] == genomes[2]
         same, n2 = pad_population(genomes, 3)
         assert n2 == 3 and same == genomes
+
+
+class TestGenomeCostModel:
+    """Big-genome regime (DISTRIBUTED.md): the jax-free cost model and its
+    classification against a per-device memory budget."""
+
+    COST = dict(nodes=(3,), filters=(8,), input_shape=(8, 8, 1),
+                dense_units=32, n_classes=4, compute_dtype="float32")
+
+    def test_cost_model_monotone(self):
+        base = cnn_genome_cost(**self.COST)
+        wider = cnn_genome_cost(**{**self.COST, "filters": (16,)})
+        deeper = cnn_genome_cost(**{**self.COST, "nodes": (5,)})
+        staged = cnn_genome_cost(**{**self.COST, "nodes": (3, 3),
+                                    "filters": (8, 8)})
+        for bigger in (wider, deeper):
+            assert bigger.param_bytes > base.param_bytes
+            assert bigger.act_bytes_per_example > base.act_bytes_per_example
+        # an extra stage always adds live activations; its params can go
+        # EITHER way (the extra pool shrinks the dense layer's input), so
+        # only the activation term is asserted monotone in stage count
+        assert staged.act_bytes_per_example > base.act_bytes_per_example
+        # half-precision compute halves activation bytes, not param state
+        # (params/momentum/grads are kept float32)
+        half = cnn_genome_cost(**{**self.COST, "compute_dtype": "bfloat16"})
+        assert half.act_bytes_per_example < base.act_bytes_per_example
+        assert half.param_bytes == base.param_bytes
+
+    def test_cost_model_is_jax_free(self):
+        """The dispatch plane classifies jobs without touching a backend:
+        mesh.py loaded standalone (the package __init__ would pull jax)
+        must leave jax out of sys.modules through a full classify
+        round-trip."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from gentun_tpu.parallel import mesh as mesh_mod
+
+        prog = textwrap.dedent(f"""
+            import importlib.util, sys
+            spec = importlib.util.spec_from_file_location(
+                "meshonly", {mesh_mod.__file__!r})
+            m = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(m)
+            cost = m.cnn_genome_cost((3,), (8,), (8, 8, 1), 32, 4, "float32")
+            assert m.classify_genome_cost(cost, 32, 8, 10**12) == ("small", 1)
+            assert m.job_size_class({{"device_budget": 1}}) == "small"
+            assert m.mesh_factor(8, 16) == (8, 1)
+            leaked = [n for n in sys.modules if n == "jax" or n.startswith("jax.")]
+            assert not leaked, f"jax leaked into sys.modules: {{leaked}}"
+        """)
+        res = subprocess.run([sys.executable, "-c", prog],
+                             capture_output=True, text=True)
+        assert res.returncode == 0, res.stderr
+
+    def test_size_class_edges(self):
+        cost = cnn_genome_cost(**self.COST)
+        exact = cost.param_bytes + cost.act_bytes_per_example * 32
+        # exactly at budget stays small (<=, not <): the wide-pop path
+        assert classify_genome_cost(cost, 32, 8, exact) == (SIZE_SMALL, 1)
+        assert classify_genome_cost(cost, 32, 8, exact - 1)[0] == SIZE_BIG
+        # fits only with the batch sharded over the full 8-wide data axis
+        big = cost.param_bytes + cost.act_bytes_per_example * 8
+        assert classify_genome_cost(cost, 32, 8, big) == (SIZE_BIG, 1)
+        # even the full-axis shard (4 examples/device) oversubscribes:
+        # accumulate over the smallest batch divisor whose slice fits
+        micro = cost.param_bytes + cost.act_bytes_per_example * 2
+        assert classify_genome_cost(cost, 32, 8, micro) == (SIZE_MICRO, 2)
+        # params + one example over budget: unevaluable at ANY factoring
+        with pytest.raises(ValueError, match="unevaluable"):
+            classify_genome_cost(cost, 32, 8, cost.param_bytes)
+
+    def test_job_size_class_degrades_quietly(self):
+        """Wire-config classification mirrors broker._parse_mesh: dispatch
+        must route jobs from any master version, so feature-off, partial,
+        and even unevaluable configs all degrade to small — the evaluator
+        raises the loud error with full context."""
+        assert job_size_class(None) == SIZE_SMALL
+        assert job_size_class({}) == SIZE_SMALL
+        assert job_size_class({"device_budget": None}) == SIZE_SMALL
+        # no input_shape/n_classes on the wire (worker infers from data)
+        assert job_size_class({"device_budget": 10**9}) == SIZE_SMALL
+        tight = dict(self.COST, kernels_per_layer=(8,), batch_size=32,
+                     device_budget=1)
+        tight.pop("filters")
+        assert job_size_class(tight) == SIZE_SMALL  # unevaluable: degrade
+        cost = cnn_genome_cost(**self.COST)
+        big = dict(tight,
+                   device_budget=cost.param_bytes + cost.act_bytes_per_example * 8)
+        assert job_size_class(big, n_devices=8) == SIZE_BIG
 
 
 class TestShardedTraining:
@@ -173,6 +313,55 @@ class TestShardedTraining:
         GeneticCnnModel.cross_validate_population(x, y, genomes8[:3], **cfg)
         assert reg.counter("eval_pad_waste_total").value == 5
         reg.reset()
+
+    def test_generous_budget_keeps_small_path_bit_identical(self, separable_data):
+        """Feature on but genomes small: device_budget must only REROUTE
+        big genomes — the wide-pop vmap path stays BIT identical to
+        feature-off (same program, same cache keys, same fitnesses)."""
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (0, 1, 1)}]
+        ref = GeneticCnnModel.cross_validate_population(x, y, genomes, **FAST)
+        on = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, device_budget=10**12, **FAST)
+        assert np.array_equal(ref, on)
+
+    def test_big_genome_data_sharded_path(self, separable_data):
+        """A budget that forces the big class routes one-genome programs
+        over the (1, 8) data-sharded mesh — bit-identical here (float32
+        CPU, batch 32 divides the axis) — and a tighter budget exercises
+        microbatch gradient accumulation (numerics legitimately differ:
+        dropout masks follow the micro-slice shape, so only sanity-check)."""
+        from gentun_tpu.telemetry.registry import get_registry
+
+        x, y = separable_data
+        genomes = [{"S_1": (1, 0, 1)}, {"S_1": (0, 1, 1)}]
+        ref = GeneticCnnModel.cross_validate_population(x, y, genomes, **FAST)
+        cost = cnn_genome_cost((3,), (8,), (8, 8, 1), 32, 4, "float32")
+        reg = get_registry()
+        reg.reset()
+        big_budget = cost.param_bytes + cost.act_bytes_per_example * 8
+        big = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, device_budget=big_budget, **FAST)
+        assert np.array_equal(ref, big)
+        assert reg.counter("microbatch_steps_total").value == 0
+        micro_budget = cost.param_bytes + cost.act_bytes_per_example * 2
+        micro = GeneticCnnModel.cross_validate_population(
+            x, y, genomes, device_budget=micro_budget, **FAST)
+        assert micro.shape == (2,)
+        assert (micro > 0.4).all()
+        assert reg.counter("microbatch_steps_total").value > 0
+        reg.reset()
+
+    def test_unevaluable_budget_is_loud(self, separable_data):
+        """Evaluator-side classification never degrades: a genome whose
+        parameter state + one example exceeds the budget raises before
+        any compile."""
+        x, y = separable_data
+        cost = cnn_genome_cost((3,), (8,), (8, 8, 1), 32, 4, "float32")
+        with pytest.raises(ValueError, match="unevaluable"):
+            GeneticCnnModel.cross_validate_population(
+                x, y, [{"S_1": (1, 0, 1)}], device_budget=cost.param_bytes,
+                **FAST)
 
     def test_auto_mesh_is_default(self, separable_data):
         """mesh='auto' engages the 8-device mesh without explicit config."""
