@@ -29,6 +29,7 @@ from .populations import Population
 from .telemetry import health as _health
 from .telemetry import lineage as _lineage
 from .telemetry import spans as _tele
+from .telemetry.registry import get_registry as _get_registry
 from .utils.fitness_store import FITNESS_PROTOCOL, is_serializable_key, tuplify
 
 __all__ = ["GeneticAlgorithm", "RussianRouletteGA"]
@@ -238,6 +239,12 @@ class GeneticAlgorithm:
                     best = self.population.get_fittest()
         finally:
             _health.unregister_engine_status(self._status_session, self._ops_status)
+            # End-of-run fleet push: the final generation's counters reach
+            # the aggregator even if the caller keeps the population open.
+            # No-op (an empty-dict read) when nothing is wired.
+            from .telemetry.aggregator import flush_active_pushers
+
+            flush_active_pushers()
         logger.info("search done: best fitness %.6g, genes %s", best.get_fitness(), best.get_genes())
         return best
 
@@ -280,6 +287,19 @@ class GeneticAlgorithm:
             # the north-star metric (BASELINE.json): individuals/hour/chip
             "individuals_per_hour_per_chip": round(evaluated / (elapsed_s / 3600.0) / n_chips, 2),
         }
+        # Search-progress gauges for the fleet dashboard (once per
+        # generation — off the dispatch hot path; always-on like the mesh
+        # gauges so an aggregator-wired master reports progress even with
+        # span telemetry off).
+        sess = (getattr(self, "_status_session", None)
+                or getattr(self.population, "session", None) or "default")
+        reg = _get_registry()
+        reg.gauge("engine_generation", session=sess,
+                  mode="generational").set(self.generation)
+        fit = fittest.get_fitness()
+        if fit is not None:
+            reg.gauge("engine_best_fitness", session=sess,
+                      mode="generational").set(float(fit))
         # Distributed populations report their failure-recovery bookkeeping
         # (bounded retries / penalized stragglers) — record it so a resumed
         # or audited search can see exactly which generations degraded.

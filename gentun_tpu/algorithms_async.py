@@ -531,6 +531,11 @@ class AsyncEvolution:
                         work.target._promo_pending = False
                 self._evaluator = None
                 evaluator.close()
+                # End-of-run fleet push (no-op when nothing is wired):
+                # the final completion counters reach the aggregator.
+                from .telemetry.aggregator import flush_active_pushers
+
+                flush_active_pushers()
         if self.best is None:
             raise RuntimeError("no evaluation ever completed successfully")
         logger.info(
@@ -965,6 +970,16 @@ class AsyncEvolution:
         if self.completed - self._last_ckpt < self.checkpoint_every:
             return
         self._last_ckpt = self.completed
+        # Search-progress gauges for the fleet dashboard — the async
+        # analogue of the generational engine's per-generation set, at the
+        # same cadence as the checkpoint boundary (never per completion).
+        sess = getattr(self, "_status_session", None) or "default"
+        reg = _get_registry()
+        reg.gauge("engine_completions", session=sess,
+                  mode="async").set(self.completed)
+        if self.best is not None and self.best.get_fitness() is not None:
+            reg.gauge("engine_best_fitness", session=sess,
+                      mode="async").set(float(self.best.get_fitness()))
         if self._checkpointer is not None:
             with _tele.span("checkpoint"):
                 self._checkpointer.save(self)

@@ -187,6 +187,12 @@ class JobBroker:
         its session, instead of crash-looping through the whole fleet.
         ``None`` (default) preserves unbounded AMQP-style disconnect
         redelivery — required by the chaos suite's kill/redeliver tests.
+    aggregator_url:
+        Optional fleet metrics aggregator (``telemetry/aggregator.py``):
+        while the broker runs, this process pushes metric snapshots there
+        under role ``broker`` (shared per-process pusher — a master that
+        also wired the URL merges roles instead of double-counting).
+        Fail-open: aggregator downtime never touches dispatch.
     """
 
     def __init__(
@@ -202,9 +208,16 @@ class JobBroker:
         straggler_requeue: bool = False,
         quarantine_after: int = 3,
         quarantine_crash_requeues: Optional[int] = None,
+        aggregator_url: Optional[str] = None,
     ):
         self._host = host
         self._port = port
+        # Fleet observability (telemetry/aggregator.py): pushing starts
+        # with the broker and stops with it.  acquire_pusher dedups per
+        # URL, so a master that also wired aggregator_url shares this
+        # process's pusher (roles merge) instead of double-counting.
+        self._aggregator_url = aggregator_url
+        self._pusher = None
         self._token = token
         self._heartbeat_timeout = float(heartbeat_timeout)
         self._max_attempts = int(max_attempts)
@@ -293,6 +306,9 @@ class JobBroker:
             "broker_loop", timeout=max(2.0, 10.0 * self._watchdog_interval))
         _health.register_watchdog(self._watchdog)
         _health.register_status_provider("fleet", self._ops_status)
+        if self._aggregator_url and self._pusher is None:
+            from ..telemetry.aggregator import acquire_pusher
+            self._pusher = acquire_pusher(self._aggregator_url, role="broker")
         return self
 
     def stop(self) -> None:
@@ -338,6 +354,10 @@ class JobBroker:
         _health.unregister_watchdog(self._watchdog)
         _health.unregister_status_provider("fleet", self._ops_status)
         _health.unregister_source("broker_loop")
+        if self._pusher is not None:
+            from ..telemetry.aggregator import release_pusher
+            release_pusher(self._pusher)
+            self._pusher = None
         self._watchdog.clear()
 
     def _run_loop(self) -> None:

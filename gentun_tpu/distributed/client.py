@@ -163,6 +163,7 @@ class GentunClient:
         fitness_store: Optional[str] = None,
         cache_url: Optional[str] = None,
         compile_cache_url: Optional[str] = None,
+        aggregator_url: Optional[str] = None,
         fault_injector=None,
     ):
         self.species = species
@@ -276,6 +277,16 @@ class GentunClient:
             self._compile_client = CompileServiceClient(
                 compile_cache_url,
                 probe_devices=getattr(species, "uses_jax", False))
+        # Fleet observability (telemetry/aggregator.py): the URL is only
+        # validated here (loud ValueError → SystemExit in the CLI); the
+        # pusher itself starts with work() and stops when work() returns,
+        # under this worker's id as the fleet instance label.
+        self._aggregator_url = None
+        if aggregator_url:
+            from ..telemetry.aggregator import parse_aggregator_url
+
+            self._aggregator_url = parse_aggregator_url(aggregator_url)
+        self._pusher = None
         if self.multihost:
             from ..parallel import multihost as mh  # imports jax (opt-in only)
 
@@ -553,6 +564,11 @@ class GentunClient:
         _health.register_source(
             "worker_heartbeat", timeout=max(5.0, 4.0 * self.heartbeat_interval))
         _health.register_status_provider("worker", self._ops_status)
+        if self._aggregator_url and self._pusher is None:
+            from ..telemetry.aggregator import acquire_pusher
+
+            self._pusher = acquire_pusher(
+                self._aggregator_url, role="worker", instance=self.worker_id)
         hb = threading.Thread(target=self._heartbeat_loop, name="gentun-heartbeat", daemon=True)
         hb.start()
         if self._compile_client is not None:
@@ -598,6 +614,11 @@ class GentunClient:
                 self._compile_client.close()
             _health.unregister_status_provider("worker", self._ops_status)
             _health.unregister_source("worker_heartbeat")
+            if self._pusher is not None:
+                from ..telemetry.aggregator import release_pusher
+
+                release_pusher(self._pusher)
+                self._pusher = None
             if self.multihost:
                 self._mh.broadcast_payload(None)  # release the followers
         return self._jobs_done

@@ -110,6 +110,11 @@ class DistributedPopulation(Population):
       the tenant; quotas govern compute, not cache hits).  Set it only to
       ISOLATE a tenant whose measurements must not be shared (different
       data, incompatible species).
+    - ``aggregator_url``: optional fleet metrics aggregator
+      (``telemetry/aggregator.py``).  The master pushes periodic metric
+      snapshots there (role ``master``; the owned broker merges into the
+      same per-process pusher) for the life of the population.  Fail-open
+      with cooldown — aggregator downtime can never touch a search.
     """
 
     def __init__(
@@ -145,6 +150,7 @@ class DistributedPopulation(Population):
         session_weight: float = 1.0,
         session_quota: Optional[int] = None,
         cache_namespace: Optional[str] = None,
+        aggregator_url: Optional[str] = None,
     ):
         if failed_policy not in ("raise", "penalize"):
             raise ValueError(f"unknown failed_policy {failed_policy!r}")
@@ -181,6 +187,17 @@ class DistributedPopulation(Population):
             # clone's close() can evict it.
             self._cache_status_fn = cache.stats
             _health.register_status_provider("fitness_service", self._cache_status_fn)
+        # Fleet observability (telemetry/aggregator.py): the master pushes
+        # its metric snapshots for as long as this population lives.  The
+        # per-process pusher is refcounted and shared per URL, so the owned
+        # in-process broker below wiring the same URL merges into one
+        # instance (role "master+broker") — never a double-counted fleet.
+        self.aggregator_url = aggregator_url
+        self._pusher = None
+        if aggregator_url:
+            from ..telemetry.aggregator import acquire_pusher
+
+            self._pusher = acquire_pusher(aggregator_url, role="master")
         super().__init__(
             species,
             x_train=None,
@@ -216,6 +233,7 @@ class DistributedPopulation(Population):
                 straggler_floor_s=straggler_floor_s,
                 straggler_k=straggler_k,
                 straggler_requeue=straggler_requeue,
+                aggregator_url=aggregator_url,
             ).start()
             self._owns_broker = True
         # Session tenancy: an explicit session is opened on the broker
@@ -261,6 +279,13 @@ class DistributedPopulation(Population):
                 self.broker.close_session(self._session_arg)
             if self._owns_broker:
                 self.broker.stop()
+            if self._pusher is not None:
+                # After the broker's own release: the final flush then
+                # carries the fully-settled end-of-run counters.
+                from ..telemetry.aggregator import release_pusher
+
+                release_pusher(self._pusher)
+                self._pusher = None
 
     def __enter__(self) -> "DistributedPopulation":
         return self

@@ -178,6 +178,15 @@ def main(argv=None) -> int:
                          "(write-behind).  Degrades to local compiles when "
                          "unreachable.  Not available with --coordinator "
                          "(multihost).")
+    ap.add_argument("--aggregator-url", default=None, metavar="URL",
+                    help="fleet metrics aggregator "
+                         "(telemetry/aggregator.py), e.g. "
+                         "http://agg-host:9100: push this worker's metric "
+                         "snapshots there every few seconds under its "
+                         "--worker-id, feeding the fleet /metrics, the "
+                         "/statusz version-skew table, and the SLO engine "
+                         "behind /alertz.  Fail-open with cooldown — "
+                         "aggregator downtime never touches evaluation.")
     ap.add_argument("--fault-plan", default=None, metavar="PATH",
                     help="chaos testing: JSON FaultPlan (distributed/faults.py) "
                          "injected into this worker's client hooks")
@@ -250,6 +259,13 @@ def main(argv=None) -> int:
             args.cache_url = parse_cache_url(args.cache_url)
         except ValueError as e:
             raise SystemExit(f"--cache-url: {e}")
+    if args.aggregator_url is not None:
+        from ..telemetry.aggregator import parse_aggregator_url
+
+        try:
+            args.aggregator_url = parse_aggregator_url(args.aggregator_url)
+        except ValueError as e:
+            raise SystemExit(f"--aggregator-url: {e}")
     if args.compile_cache_url is not None:
         from .fitness_service import parse_cache_url
 
@@ -329,6 +345,7 @@ def main(argv=None) -> int:
             fitness_store=args.fitness_store,
             cache_url=args.cache_url,
             compile_cache_url=args.compile_cache_url,
+            aggregator_url=args.aggregator_url,
             fault_injector=injector,
         )
     except ValueError as e:

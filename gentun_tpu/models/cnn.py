@@ -1002,6 +1002,62 @@ def _account_sharded_batch(cfg: Dict[str, Any], mesh, batch_size: int, steps: in
         )
 
 
+def _record_cost_calibration(cfg: Dict[str, Any], params, n_slots: int) -> None:
+    """Calibrate the dispatch cost model against what jax actually built.
+
+    The scheduling plane sizes genomes with ``cnn_genome_cost`` — a static
+    prediction.  Every population init is a free chance to measure how far
+    that prediction sits from reality, so record both sides as
+    ``genome_cost_calibration{size_class,source}`` gauges:
+
+    - ``predicted_param_bytes`` / ``predicted_act_bytes_batch``: the cost
+      model's claim (params×3 f32 convention; activations in compute dtype
+      for one full batch);
+    - ``measured_param_bytes``: per-genome-slot bytes of the freshly
+      initialised tree × 3 (params + momentum + grads, the same convention
+      the prediction uses), leaves divided by the ``(kfold, P)`` stacking
+      prefix;
+    - ``device_bytes_in_use``: the backend allocator's own number when it
+      has one (TPU/GPU ``memory_stats``; absent on CPU).
+
+    Fleet-side, the aggregator surfaces these per size class so a drifting
+    cost model is visible before it mis-schedules a big genome.  Fail-soft:
+    calibration must never be able to kill an evaluation.
+    """
+    try:
+        size_class, _ = _genome_size_class(cfg)
+        cost = cnn_genome_cost(
+            cfg["nodes"],
+            cfg["kernels_per_layer"],
+            cfg["input_shape"],
+            cfg["dense_units"],
+            cfg["n_classes"],
+            cfg["compute_dtype"],
+            bool(cfg["stage_exit_conv"]),
+        )
+        reg = _get_registry()
+
+        def _gauge(source: str, value: float) -> None:
+            reg.gauge(
+                "genome_cost_calibration", size_class=size_class, source=source
+            ).set(float(value))
+
+        _gauge("predicted_param_bytes", cost.param_bytes)
+        _gauge(
+            "predicted_act_bytes_batch",
+            cost.act_bytes_per_example * int(cfg["batch_size"]),
+        )
+        leaf_bytes = sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+        )
+        _gauge("measured_param_bytes", 3 * leaf_bytes / max(1, n_slots))
+        stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
+        if stats and "bytes_in_use" in stats:
+            _gauge("device_bytes_in_use", stats["bytes_in_use"])
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        logger.debug("cost calibration skipped: %s", exc)
+
+
 #: Mesh shape of the previous evaluation in this process — feeds the
 #: ``mesh_reshapes_total`` counter (docs/OBSERVABILITY.md): every flip is
 #: a sharding layout change, and interleaving size classes carelessly
@@ -1321,6 +1377,7 @@ class GeneticCnnModel(GentunModel):
         params = _init_population_params(
             model, stacked, cfg["input_shape"], pop, kfold, cfg["seed"], hashes
         )
+        _record_cost_calibration(cfg, params, kfold * pop)
         # Parent→child weight inheritance (multi-fidelity ladder): overlay
         # each slot's own lower-rung trained params where shapes match, and
         # bank fold-0 results for the NEXT rung.  Segmented single-process
@@ -1490,6 +1547,7 @@ class GeneticCnnModel(GentunModel):
             model, stacked, cfg["input_shape"], pop, 1, cfg["seed"], hashes,
             domain=_HOLDOUT_DOMAIN,
         )
+        _record_cost_calibration(cfg, params, pop)
         keys = _content_keys(
             jax.random.fold_in(jax.random.PRNGKey(cfg["seed"]), _HOLDOUT_DOMAIN),
             1, hashes,

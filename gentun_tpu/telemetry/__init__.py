@@ -19,6 +19,17 @@ Plus the live ops plane (OBSERVABILITY.md "Live ops plane"):
 - :mod:`.ops_server` — ``/metrics`` + ``/healthz`` + ``/statusz`` +
   ``/debugz/flight`` on a stdlib HTTP server in a daemon thread.
 
+The fleet aggregation plane (OBSERVABILITY.md "Fleet aggregation & SLOs"):
+
+- :mod:`.aggregator` — push-gateway :class:`~.aggregator.MetricsAggregator`
+  (merged fleet ``/metrics``/``/statusz``/``/alertz``/``/ringz``) and the
+  degradation-safe :class:`~.aggregator.TelemetryPusher` every process
+  wires via ``aggregator_url=`` / ``--aggregator-url``.
+- :mod:`.slo` — the declarative burn-rate rule table and alert state
+  machine the aggregator evaluates over its time-series rings.
+- :mod:`.buildinfo` — the ``build_info`` version-identity gauge behind
+  the fleet version-skew table.
+
 And the search-forensics plane (OBSERVABILITY.md "Search forensics"):
 
 - :mod:`.lineage` — per-genome lineage ledger (born/dispatched/completed/
@@ -35,6 +46,16 @@ Quick start::
         ga.run(generations)
 """
 
+from .aggregator import (
+    AGG_PROTOCOL,
+    MetricsAggregator,
+    TelemetryPusher,
+    acquire_pusher,
+    flush_active_pushers,
+    parse_aggregator_url,
+    release_pusher,
+)
+from .buildinfo import build_info_labels, set_build_info
 from .export import RunTelemetry, active_run, end_run, start_run
 from .flight import FlightRecorder
 from .health import StallWatchdog
@@ -43,11 +64,13 @@ from .ops_server import OpsServer, active_ops_server, start_ops_server, stop_ops
 from .registry import (
     DEFAULT_BUCKETS,
     Counter,
+    DeltaSnapshotter,
     Gauge,
     Histogram,
     MetricsRegistry,
     get_registry,
 )
+from .slo import SeriesPoints, SloEngine, SloRule, default_rules
 from .spans import (
     attach,
     capture,
@@ -91,4 +114,18 @@ __all__ = [
     "start_ops_server",
     "stop_ops_server",
     "active_ops_server",
+    "AGG_PROTOCOL",
+    "MetricsAggregator",
+    "TelemetryPusher",
+    "acquire_pusher",
+    "release_pusher",
+    "flush_active_pushers",
+    "parse_aggregator_url",
+    "DeltaSnapshotter",
+    "SloEngine",
+    "SloRule",
+    "SeriesPoints",
+    "default_rules",
+    "build_info_labels",
+    "set_build_info",
 ]

@@ -1,0 +1,71 @@
+"""`build_info`: the version-skew tripwire gauge.
+
+Every process that serves ``/metrics`` (ops server) or pushes to the
+fleet aggregator stamps a single ``build_info`` gauge whose *labels*
+carry the identity that matters operationally: package version, the
+checkpoint schema, the fitness/compile wire protocols, and the jax/jaxlib
+versions.  The value is always 1 — Prometheus convention: information
+rides in labels, and ``sum by (version) (build_info)`` counts processes
+per build.  The aggregator folds the pushed gauges into the fleet
+version-skew table on its ``/statusz``, which is where a half-upgraded
+fleet becomes visible *before* the 409s start.
+
+Imports are lazy and fail-soft: the telemetry plane must stay importable
+on minimal installs (no jax on the GA outer loop — registry.py's
+zero-dependency constraint), and a missing constant reports ``"unknown"``
+rather than breaking metrics export.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+__all__ = ["build_info_labels", "set_build_info"]
+
+_CACHED: Optional[Dict[str, str]] = None
+
+
+def build_info_labels() -> Dict[str, str]:
+    """The identity labels, computed once per process."""
+    global _CACHED
+    if _CACHED is not None:
+        return dict(_CACHED)
+    labels: Dict[str, str] = {}
+
+    def probe(key: str, fn) -> None:
+        try:
+            labels[key] = str(fn())
+        except Exception:  # noqa: BLE001 - identity is best-effort
+            labels[key] = "unknown"
+
+    probe("version", lambda: __import__(
+        "gentun_tpu").__version__)
+    probe("checkpoint_schema", lambda: __import__(
+        "gentun_tpu.utils.checkpoint", fromlist=["CHECKPOINT_SCHEMA"]
+    ).CHECKPOINT_SCHEMA)
+    probe("fitness_protocol", lambda: __import__(
+        "gentun_tpu.utils.fitness_store", fromlist=["FITNESS_PROTOCOL"]
+    ).FITNESS_PROTOCOL)
+    probe("compile_protocol", lambda: __import__(
+        "gentun_tpu.distributed.compile_service", fromlist=["COMPILE_PROTOCOL"]
+    ).COMPILE_PROTOCOL)
+    # jax is optional on purpose: workers on minimal installs and the GA
+    # outer loop never import it, and build_info must not drag it in if
+    # it is not already loaded elsewhere in the process.
+    try:
+        import importlib.metadata as _md
+        labels["jax"] = _md.version("jax")
+        labels["jaxlib"] = _md.version("jaxlib")
+    except Exception:  # noqa: BLE001 - absent on minimal installs
+        labels.setdefault("jax", "absent")
+        labels.setdefault("jaxlib", "absent")
+    _CACHED = labels
+    return dict(labels)
+
+
+def set_build_info(registry: Optional[MetricsRegistry] = None) -> None:
+    """Stamp the ``build_info`` gauge (value 1) on ``registry``."""
+    reg = registry if registry is not None else get_registry()
+    reg.gauge("build_info", **build_info_labels()).set(1)

@@ -130,6 +130,11 @@ class OpsServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "OpsServer":
+        # Version identity on every /metrics page this process serves —
+        # the aggregator folds the pushed copy into the fleet version-skew
+        # table, and a lone scraped process still self-identifies.
+        from .buildinfo import set_build_info
+        set_build_info(self.registry)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.25},
             name="gentun-ops-server", daemon=True)

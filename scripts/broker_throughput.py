@@ -423,6 +423,73 @@ def run_sizeclass_gate(per_job_dispatch_us: float) -> dict:
     }
 
 
+def run_aggregator_gate(per_job_dispatch_us: float,
+                        interval_s: float = 2.0) -> dict:
+    """Fleet-metrics push-path cost on a pushing process, micro-timed.
+
+    A process wired to a metrics aggregator pays NOTHING per metric write
+    (the ``DeltaSnapshotter`` reads instruments only at flush time) — the
+    recurring cost is one ``TelemetryPusher._build_payload()`` per flush
+    interval: a full O(#instruments) memoization scan plus payload dicts
+    for whatever moved.  (The HTTP POST itself rides the background
+    flusher thread, but on a saturated one-core box its CPU is real, so
+    the scan — the deterministic part — is what the gate times.)  Same
+    instrument as the forensics/compile/surrogate/sizeclass gates: build
+    a representative fleet-process registry (~130 series), time the
+    steady-state scan with a realistic handful of moved instruments per
+    flush, amortize over the jobs one flush interval spans at the
+    measured dispatch rate, divide by per-job dispatch cost."""
+    from gentun_tpu.telemetry.aggregator import TelemetryPusher
+    from gentun_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    # A representative pushing process: the metric catalog is ~40 names,
+    # label fan-out (sessions, workers, size classes) multiplies series.
+    for i in range(32):
+        reg.counter(f"bench_counter_{i}").inc()
+    for i in range(16):
+        for session in ("a", "b", "c"):
+            reg.counter("bench_labeled_total", session=session,
+                        idx=str(i)).inc()
+    for i in range(24):
+        reg.gauge(f"bench_gauge_{i}").set(float(i))
+    for i in range(8):
+        h = reg.histogram(f"bench_hist_{i}")
+        for v in (0.01, 0.1, 1.0):
+            h.observe(v)
+    n_series = sum(len(v) for v in reg.snapshot().values())
+    # The URL is never dialed: _build_payload is pure in-process work.
+    pusher = TelemetryPusher("http://127.0.0.1:9", role="worker",
+                             instance="bench", interval=interval_s,
+                             full_every=1000000, registry=reg)
+    pusher._build_payload()  # prime the memoization (first scan ships all)
+
+    movers = [reg.counter(f"bench_counter_{i}") for i in range(8)]
+
+    def _flush():
+        for c in movers:  # a realistic flush: a few counters moved
+            c.inc()
+        pusher._build_payload()
+
+    reps, inner = 3, 2000
+    t_flush_s = min(timeit.repeat(_flush, number=inner, repeat=reps)) / inner
+    # One flush serves every job dispatched during the interval.
+    jobs_per_flush = interval_s * 1e6 / per_job_dispatch_us
+    per_job_added_us = round(t_flush_s / jobs_per_flush * 1e6, 4)
+    overhead_pct = round(per_job_added_us / per_job_dispatch_us * 100.0, 3)
+    return {
+        "registry_series": n_series,
+        "flush_scan_us": round(t_flush_s * 1e6, 3),
+        "push_interval_s": interval_s,
+        "jobs_per_flush": int(jobs_per_flush),
+        "per_job_added_us": per_job_added_us,
+        "per_job_dispatch_us": per_job_dispatch_us,
+        "overhead_pct": overhead_pct,
+        "gate_max_pct": 2.0,
+        "within_gate": overhead_pct <= 2.0,
+    }
+
+
 def main() -> dict:
     # Single-tenant pass first (the historical headline numbers), then the
     # same workload split across 4 fair-share sessions: the difference is
@@ -490,6 +557,18 @@ def main() -> dict:
         f"{out['sizeclass']['overhead_pct']}% exceeds the 2% gate "
         f"({out['sizeclass']['per_job_added_us']}us added on "
         f"{out['sizeclass']['per_job_dispatch_us']}us/job dispatch)")
+
+    # Fleet-aggregation push-path gate (OBSERVABILITY.md "Fleet
+    # aggregation & SLOs"): the periodic snapshot-delta scan a pushing
+    # process pays must stay <=2% of per-job dispatch cost, amortized
+    # over the jobs one flush interval spans.  Same denominator again.
+    out["aggregator_push"] = run_aggregator_gate(
+        out["forensics"]["per_job_dispatch_us"])
+    assert out["aggregator_push"]["within_gate"], (
+        f"aggregator push-path overhead "
+        f"{out['aggregator_push']['overhead_pct']}% exceeds the 2% gate "
+        f"({out['aggregator_push']['per_job_added_us']}us added on "
+        f"{out['aggregator_push']['per_job_dispatch_us']}us/job dispatch)")
 
     # Informational (not gated): the full per-job accounting fare.  When a
     # master runs full forensics it stamps `fz` into the propagated trace

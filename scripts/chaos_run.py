@@ -31,6 +31,13 @@ evaluation failures must surface as ``requeued`` and ``quarantined``
 lineage events in the run artifact, keyed to the poison genome — chaos
 is not just survived, it is narrated.
 
+An observability act (``run_obs_agg``) kills the fleet metrics
+aggregator (``telemetry/aggregator.py``) mid-search: the shared
+telemetry pusher must fail OPEN — exactly ONE ``aggregator_degraded``
+event per up→down transition — and the finished search must be
+bit-identical to an aggregator-free run (observability can drop data,
+never steer a search).
+
 CPU-only, a few seconds: `python scripts/chaos_run.py` writes
 ``scripts/chaos_run.json``.  The plan is serialized into the artifact, so
 a recorded run can be replayed exactly.
@@ -90,13 +97,14 @@ def _free_port() -> int:
     return port
 
 
-def _worker(port, injector=None, worker_id=None, species=None):
+def _worker(port, injector=None, worker_id=None, species=None,
+            aggregator_url=None):
     stop = threading.Event()
     client = GentunClient(
         species or OneMax, *DATA, host="127.0.0.1", port=port,
         worker_id=worker_id,
         heartbeat_interval=0.2, reconnect_delay=0.05, reconnect_max_delay=0.5,
-        fault_injector=injector,
+        fault_injector=injector, aggregator_url=aggregator_url,
     )
     t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
     t.start()
@@ -811,6 +819,138 @@ def run_forensics_act() -> dict:
     }
 
 
+def run_obs_agg() -> dict:
+    """Metrics-aggregator kill act: the fleet observability plane
+    (``telemetry/aggregator.py``) dies mid-search.  Observability downtime
+    must never fail or steer a search — every wired role keeps running,
+    the process's (refcounted, shared) pusher fails OPEN with exactly ONE
+    ``aggregator_degraded`` telemetry event per up→down transition, and
+    the finished search is bit-identical to an aggregator-free run.
+
+    ``SlowishOneMax`` plus a high per-bit mutation rate keep every
+    generation training novel genomes, so the kill (held until generation
+    1 has landed) strikes while dispatch is still live and the 0.25 s
+    push cadence gets several failed flush attempts before the search
+    ends — the degradation is observed DURING the run, not at teardown."""
+    from gentun_tpu.telemetry.aggregator import MetricsAggregator
+    from gentun_tpu.telemetry.registry import get_registry
+
+    mutation_rate = 0.5
+
+    # Aggregator-free reference: single-process, telemetry-free, same seeds.
+    ref = GeneticAlgorithm(
+        Population(SlowishOneMax, *DATA, size=POP_SIZE, seed=POP_SEED,
+                   mutation_rate=mutation_rate), seed=GA_SEED)
+    ref.run(GENERATIONS)
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_obsagg_telemetry.jsonl")
+    run_tele = RunTelemetry(tele_path, label="chaos-obsagg").install()
+    agg = MetricsAggregator("127.0.0.1", 0)
+    agg.start()
+    old_interval = os.environ.get("GENTUN_TPU_AGG_PUSH_INTERVAL")
+    os.environ["GENTUN_TPU_AGG_PUSH_INTERVAL"] = "0.25"
+    port = _free_port()
+    killed_after_gen = []
+    pushes_before_kill = []
+    t0 = time.monotonic()
+    stops = []
+    try:
+        pop = DistributedPopulation(
+            SlowishOneMax, size=POP_SIZE, seed=POP_SEED,
+            mutation_rate=mutation_rate, host="127.0.0.1", port=port,
+            job_timeout=120, aggregator_url=agg.url)
+        try:
+            stops = [_worker(port, worker_id="obs-w0", species=SlowishOneMax,
+                             aggregator_url=agg.url),
+                     _worker(port, worker_id="obs-w1", species=SlowishOneMax,
+                             aggregator_url=agg.url)]
+            ga = GeneticAlgorithm(pop, seed=GA_SEED)
+
+            def _kill_aggregator():
+                # Pull the plug once generation 1 has landed AND at least
+                # one snapshot has been pushed — squarely mid-search, with
+                # dispatch still running and the aggregator demonstrably
+                # receiving before it dies.
+                while not ga.history or agg.stats()["pushes"] < 1:
+                    time.sleep(0.005)
+                killed_after_gen.append(len(ga.history))
+                pushes_before_kill.append(agg.stats()["pushes"])
+                agg.stop()
+
+            killer = threading.Thread(target=_kill_aggregator, daemon=True)
+            killer.start()
+            ga.run(GENERATIONS)
+            killer.join(timeout=10)
+            # The shared pusher is still alive until pop.close(): give it
+            # until its next flush to observe the dead aggregator in case
+            # the search outran the 0.25 s cadence.
+            deadline = time.monotonic() + 5.0
+            reg = get_registry()
+            while time.monotonic() < deadline:
+                degraded = sum(
+                    c["value"] for c in reg.snapshot()["counters"]
+                    if c["name"] == "aggregator_degraded_total")
+                if degraded >= 1:
+                    break
+                time.sleep(0.05)
+            wall = time.monotonic() - t0
+            chaos_snap = _snapshot(ga)
+            leaked = pop.broker.outstanding()
+        finally:
+            pop.close()
+    finally:
+        for s in stops:
+            s.set()
+        run_tele.close()
+        if old_interval is None:
+            os.environ.pop("GENTUN_TPU_AGG_PUSH_INTERVAL", None)
+        else:
+            os.environ["GENTUN_TPU_AGG_PUSH_INTERVAL"] = old_interval
+        try:
+            agg.stop()
+        except Exception:
+            pass
+
+    ref_snap = _snapshot(ref)
+    identical = chaos_snap == ref_snap
+    assert identical, "aggregator-kill run diverged from the aggregator-free run"
+    assert len(ga.history) == GENERATIONS, "search did not complete"
+    assert killed_after_gen[0] < GENERATIONS, (
+        f"aggregator outlived the search: killed after generation "
+        f"{killed_after_gen[0]}")
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+    assert degraded >= 1, "aggregator kill never degraded the pusher"
+
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    degraded_events = [r for r in tele_lines
+                       if r.get("type") == "event"
+                       and r.get("name") == "aggregator_degraded"]
+    # master + broker + both in-thread workers share ONE refcounted
+    # pusher (acquire_pusher dedups by URL within a process), so the
+    # whole fleet degrades with exactly one event.
+    assert len(degraded_events) == 1, (
+        f"expected ONE degraded event per pusher, got {len(degraded_events)}")
+
+    return {
+        "generations": GENERATIONS,
+        "population_size": POP_SIZE,
+        "seeds": {"population": POP_SEED, "ga": GA_SEED},
+        "mutation_rate": mutation_rate,
+        "workers": 2,
+        "aggregator_killed_after_generation": killed_after_gen[0],
+        "pushes_before_kill": pushes_before_kill[0],
+        "search_completed": True,
+        "bit_identical_to_aggregator_free_run": identical,
+        "degraded_events": len(degraded_events),
+        "degraded_transitions": int(degraded),
+        "broker_state_after_final_gather": leaked,
+        "wall_s": round(wall, 3),
+    }
+
+
 def run_recompile_storm() -> dict:
     """Mass-remesh compile storm with the executable cache up: fleet-wide
     compiles must collapse to ~1 per ``(pop_bucket, static-key)`` shape.
@@ -928,6 +1068,7 @@ if __name__ == "__main__":
     out["surrogate"] = run_surrogate_act()
     out["forensics"] = run_forensics_act()
     out["recompile_storm"] = run_recompile_storm()
+    out["obs_agg"] = run_obs_agg()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

@@ -9,6 +9,17 @@ table, engine progress, and the headline counters.
     python scripts/gentun_top.py --url http://127.0.0.1:8080
     python scripts/gentun_top.py --url http://127.0.0.1:8080 --once
 
+Fleet mode (docs/OBSERVABILITY.md "Fleet aggregation & SLOs"): point it
+at a metrics aggregator instead of a single process and it renders the
+whole search fleet — per-instance push table with a sparkline column
+from the aggregator's time-series ring, active SLO alerts from
+``/alertz``, the build/version-skew table, and the reset-corrected
+fleet counter rollup:
+
+    python scripts/gentun_top.py --aggregator http://127.0.0.1:9100
+    python scripts/gentun_top.py --aggregator http://127.0.0.1:9100 \
+        --spark worker_idle_s_sum
+
 Stdlib only (urllib + ANSI escapes) — usable over ssh on a TPU-VM with
 nothing installed.  ``--once`` prints a single frame without touching
 the screen (pipe-friendly); otherwise the screen redraws every
@@ -22,6 +33,7 @@ import json
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 _CLEAR = "\x1b[2J\x1b[H"
@@ -307,12 +319,151 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
     return "\n".join(lines)
 
 
+#: Unicode eighth-blocks for the ring sparklines, lowest to highest.
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 16) -> str:
+    """Render a value series as a fixed-width unicode sparkline.
+
+    The last ``width`` samples, min-max normalised; a flat series renders
+    as a run of the lowest block rather than noise.
+    """
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return "-" * 1
+    lo, hi = min(vals), max(vals)
+    if hi - lo <= 1e-12:
+        return _SPARK_CHARS[0] * len(vals)
+    scale = (len(_SPARK_CHARS) - 1) / (hi - lo)
+    return "".join(_SPARK_CHARS[int((v - lo) * scale)] for v in vals)
+
+
+def _ring_deltas(points, counter: bool):
+    """Ring ``[[t, v], ...]`` → plottable values (counters as increments)."""
+    vals = [p[1] for p in points]
+    if not counter or len(vals) < 2:
+        return vals
+    return [max(0.0, b - a) for a, b in zip(vals, vals[1:])]
+
+
+def _fetch_agg(base: str, timeout: float, spark: str):
+    """(statusz, alertz, ringz, metrics_text) from an aggregator."""
+    try:
+        _, sz = _get(base + "/statusz", timeout)
+        _, az = _get(base + "/alertz", timeout)
+        _, rz = _get(base + f"/ringz?name={urllib.parse.quote(spark)}", timeout)
+        _, mx = _get(base + "/metrics", timeout)
+        return json.loads(sz), json.loads(az), json.loads(rz), mx.decode("utf-8")
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return None, None, None, str(e)
+
+
+def render_fleet(base: str, statusz, alertz, ringz, metrics_text,
+                 spark: str, color: bool) -> str:
+    """One frame of the fleet dashboard (aggregator mode)."""
+    B, D, R, G, Y, X = ((_BOLD, _DIM, _RED, _GREEN, _YELLOW, _RESET)
+                        if color else ("",) * 6)
+    lines = []
+    if statusz is None:
+        lines.append(f"{R}gentun-top: aggregator {base} unreachable{X} "
+                     f"({metrics_text})")
+        return "\n".join(lines)
+
+    lines.append(
+        f"{B}gentun-top [fleet]{X}  {base}  up {statusz.get('uptime_s', 0):.0f}s  "
+        f"instances {statusz.get('instances')}  series {statusz.get('series')}  "
+        f"pushes {statusz.get('pushes')} "
+        f"({statusz.get('pushes_dropped')} dropped, "
+        f"{statusz.get('resets_detected')} resets)")
+
+    # Active SLO alerts first — this is the pane the dashboard exists for.
+    active = (alertz or {}).get("active") or []
+    if active:
+        for a in active:
+            sev = a.get("severity", "ticket")
+            mark = f"{R}PAGE{X}" if sev == "page" else f"{Y}{sev}{X}"
+            val = a.get("value")
+            lines.append(
+                f"  {mark} {B}{a.get('rule')}{X} [{a.get('subject')}] "
+                f"value {val if val is None else f'{val:.4g}'}  "
+                f"{D}{a.get('description', '')}{X}")
+    else:
+        lines.append(f"  {G}no active alerts{X}  "
+                     f"{D}(fired {statusz.get('alerts_fired', 0)} / "
+                     f"cleared {statusz.get('alerts_cleared', 0)} lifetime){X}")
+
+    # Per-instance sparkline data: the requested series from the ring,
+    # counters plotted as per-push increments so activity reads as bumps.
+    sparks = {}
+    counterish = spark.endswith("_total") or spark.endswith("_count")
+    for s in (ringz or {}).get("series", []):
+        inst = (s.get("labels") or {}).get("instance")
+        if inst and s.get("points"):
+            vals = _ring_deltas(s["points"], counterish)
+            # Several label sets per instance collapse onto one lane.
+            prev = sparks.get(inst)
+            if prev and len(prev) == len(vals):
+                vals = [a + b for a, b in zip(prev, vals)]
+            sparks[inst] = vals
+
+    table = statusz.get("instance_table") or []
+    if table:
+        lines.append(f"{B}instances{X}  {D}spark: {spark}{X}")
+        lines.append(f"  {D}{'instance':<24}{'role':<16}{'series':>7}"
+                     f"{'pushes':>7}{'seen':>8}  trend{X}")
+        for i in sorted(table, key=lambda i: (i.get("role", ""),
+                                              i.get("instance", ""))):
+            inst = i.get("instance", "?")
+            stale = (f"  {R}STALE{X}" if i.get("stale") else "")
+            lines.append(
+                f"  {str(inst)[:24]:<24}{str(i.get('role', '?'))[:16]:<16}"
+                f"{i.get('n_series', '-'):>7}{i.get('pushes', '-'):>7}"
+                f"{_fmt_age(i.get('age_s')):>8}  "
+                f"{_sparkline(sparks.get(inst, []))}{stale}")
+
+    skew = statusz.get("version_skew") or {}
+    builds = skew.get("builds") or []
+    if builds:
+        head = (f"{R}VERSION SKEW{X}" if skew.get("skew")
+                else f"{G}uniform{X}")
+        lines.append(f"{B}builds{X}  {head}")
+        for b in builds:
+            members = b.get("instances", [])
+            desc = "  ".join(f"{k}={v}" for k, v in sorted(b.items())
+                             if k != "instances")
+            lines.append(f"  {desc}  {D}({len(members)}: "
+                         f"{', '.join(members[:4])}"
+                         f"{'…' if len(members) > 4 else ''}){X}")
+
+    fleet = statusz.get("fleet") or {}
+    counters = fleet.get("counters") or {}
+    headline = [(n, counters[n]) for n in _HEADLINE_COUNTERS if n in counters]
+    if headline:
+        lines.append(f"{B}fleet counters{X}  " + "  ".join(
+            f"{n.replace('_total', '')}={v:g}" for n, v in headline))
+    gauges = fleet.get("gauges") or {}
+    interesting = [(n, v) for n, v in sorted(gauges.items())
+                   if n.startswith(("engine_", "session_queue_depth"))]
+    if interesting:
+        lines.append(f"{B}fleet gauges{X}  " + "  ".join(
+            f"{n}={v:g}" for n, v in interesting))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python scripts/gentun_top.py",
         description="terminal dashboard for a gentun_tpu ops server")
     ap.add_argument("--url", default="http://127.0.0.1:8080",
                     help="ops server base URL (the --ops-port address)")
+    ap.add_argument("--aggregator", metavar="URL", default=None,
+                    help="fleet mode: a metrics aggregator base URL "
+                         "(telemetry/aggregator.py); renders the whole "
+                         "fleet instead of one process")
+    ap.add_argument("--spark", default="device_seconds_total",
+                    help="series name for the instance-table sparkline "
+                         "column (fleet mode; counters plot increments)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh period in seconds (default 2)")
     ap.add_argument("--once", action="store_true",
@@ -323,15 +474,21 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.interval <= 0:
         raise SystemExit(f"--interval must be positive, got {args.interval}")
-    base = args.url.rstrip("/")
+    base = (args.aggregator or args.url).rstrip("/")
     color = not args.no_color and (args.once or sys.stdout.isatty())
 
+    def frame_once() -> str:
+        if args.aggregator:
+            return render_fleet(base, *_fetch_agg(base, args.timeout, args.spark),
+                                spark=args.spark, color=color)
+        return render(base, *_fetch(base, args.timeout), color=color)
+
     if args.once:
-        print(render(base, *_fetch(base, args.timeout), color=color))
+        print(frame_once())
         return 0
     try:
         while True:
-            frame = render(base, *_fetch(base, args.timeout), color=color)
+            frame = frame_once()
             sys.stdout.write(_CLEAR + frame + "\n" +
                              f"{_DIM}refresh {args.interval}s — Ctrl-C to quit{_RESET}\n")
             sys.stdout.flush()

@@ -1,0 +1,454 @@
+"""Fleet metrics aggregation plane (telemetry/aggregator.py + slo.py).
+
+The aggregator is a push gateway: every process ships CUMULATIVE
+snapshots and the SERVER owns merge semantics.  These tests pin the
+parts that guard fleet-sum correctness — counter resets after a process
+restart (both the new-boot_id fold and the same-boot value drop), label
+collisions across instances, late/out-of-order pushes — plus the
+DeltaSnapshotter memoization the push-path micro-gate certifies, the
+SLO engine's fire/self-clear/flap-damping state machine, the pusher's
+ONE-degraded-event contract, and the wire guards (409 skew, exposition
+grammar).
+"""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.aggregator import (
+    AGG_PROTOCOL,
+    MetricsAggregator,
+    TelemetryPusher,
+    acquire_pusher,
+    parse_aggregator_url,
+    release_pusher,
+)
+from gentun_tpu.telemetry.buildinfo import build_info_labels
+from gentun_tpu.telemetry.registry import (
+    DeltaSnapshotter,
+    MetricsRegistry,
+    get_registry,
+)
+from gentun_tpu.telemetry.slo import (
+    SeriesPoints,
+    SloEngine,
+    SloRule,
+    default_rules,
+)
+
+# Prometheus text exposition grammar (the subset the registry and the
+# aggregator emit) — same check scripts/ops_smoke.py runs.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE+.\-]+(?: [0-9]+)?$')
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def record(self, rec):
+        self.records.append(rec)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+def _validate_prometheus(text):
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid exposition line: {line!r}"
+
+
+def _push(agg, instance, seq, counters=(), gauges=(), histograms=(),
+          boot="boot-a", role="worker"):
+    ok, detail = agg.push({
+        "instance": instance, "role": role, "boot_id": boot, "seq": seq,
+        "metrics": {"counters": list(counters), "gauges": list(gauges),
+                    "histograms": list(histograms)},
+    })
+    assert ok, detail
+    return detail
+
+
+# ---------------------------------------------------------------------------
+# DeltaSnapshotter: the memoization the ≤2% push-path gate certifies.
+
+
+class TestDeltaSnapshotter:
+    def test_first_collect_ships_everything_then_only_changes(self):
+        reg = MetricsRegistry()
+        reg.counter("jobs_total").inc(3)
+        reg.gauge("depth").set(7)
+        snap = DeltaSnapshotter(reg)
+
+        first = snap.collect()
+        assert {c["name"] for c in first["counters"]} == {"jobs_total"}
+        assert {g["name"] for g in first["gauges"]} == {"depth"}
+
+        # Nothing moved → nothing shipped.
+        assert DeltaSnapshotterTotal(snap.collect()) == 0
+
+        # Only the instrument that moved ships, with its FULL cumulative
+        # value (the server diffs, the client never does).
+        reg.counter("jobs_total").inc(2)
+        delta = snap.collect()
+        assert [c["name"] for c in delta["counters"]] == ["jobs_total"]
+        assert delta["counters"][0]["value"] == 5.0
+        assert delta["gauges"] == []
+
+    def test_full_resends_unchanged_series(self):
+        reg = MetricsRegistry()
+        reg.counter("jobs_total").inc(1)
+        snap = DeltaSnapshotter(reg)
+        snap.collect()
+        assert snap.collect()["counters"] == []
+        assert [c["name"] for c in snap.collect(full=True)["counters"]] == [
+            "jobs_total"]
+
+    def test_histogram_keyed_on_count_and_sum(self):
+        reg = MetricsRegistry()
+        reg.histogram("lat_s").observe(0.5)
+        snap = DeltaSnapshotter(reg)
+        assert len(snap.collect()["histograms"]) == 1
+        assert snap.collect()["histograms"] == []
+        reg.histogram("lat_s").observe(0.25)
+        hs = snap.collect()["histograms"]
+        assert len(hs) == 1 and hs[0]["count"] == 2
+
+    def test_label_sets_tracked_independently(self):
+        reg = MetricsRegistry()
+        reg.counter("hits", session="a").inc()
+        reg.counter("hits", session="b").inc()
+        snap = DeltaSnapshotter(reg)
+        snap.collect()
+        reg.counter("hits", session="b").inc()
+        delta = snap.collect()["counters"]
+        assert len(delta) == 1 and delta[0]["labels"] == {"session": "b"}
+
+
+def DeltaSnapshotterTotal(snapshot):
+    return sum(len(v) for v in snapshot.values())
+
+
+# ---------------------------------------------------------------------------
+# Merge semantics: resets, collisions, ordering.
+
+
+class TestMergeSemantics:
+    def test_counter_reset_same_boot_folds_into_base(self):
+        # 100 then (restarted process reusing its boot file?) 5: the fleet
+        # must read 105, never 5 and never backwards.
+        agg = MetricsAggregator("127.0.0.1", 0)
+        _push(agg, "w0", 1, counters=[{"name": "c", "labels": {}, "value": 100.0}])
+        _push(agg, "w0", 2, counters=[{"name": "c", "labels": {}, "value": 5.0}])
+        assert agg.stats()["resets_detected"] == 1
+        assert agg.statusz()["fleet"]["counters"]["c"] == 105.0
+
+    def test_boot_id_change_folds_all_cumulative_series(self):
+        agg = MetricsAggregator("127.0.0.1", 0)
+        _push(agg, "w0", 3, boot="life-1",
+              counters=[{"name": "c", "labels": {}, "value": 100.0}])
+        # New life: seq restarts from 1 and the counter restarts from 5.
+        _push(agg, "w0", 1, boot="life-2",
+              counters=[{"name": "c", "labels": {}, "value": 5.0}])
+        assert agg.statusz()["fleet"]["counters"]["c"] == 105.0
+        # Low seq was accepted because the boot changed.
+        assert agg.stats()["pushes_dropped"] == 0
+
+    def test_out_of_order_push_dropped(self):
+        agg = MetricsAggregator("127.0.0.1", 0)
+        _push(agg, "w0", 5, counters=[{"name": "c", "labels": {}, "value": 9.0}])
+        detail = _push(agg, "w0", 3,
+                       counters=[{"name": "c", "labels": {}, "value": 2.0}])
+        assert detail.get("dropped")
+        assert agg.stats()["pushes_dropped"] == 1
+        # The stale snapshot never touched the series.
+        assert agg.statusz()["fleet"]["counters"]["c"] == 9.0
+
+    def test_label_collision_across_instances_sums_not_clobbers(self):
+        # Two workers emit the identical (name, labels) series; the fleet
+        # rollup must sum them and the exposition must keep them apart via
+        # the injected instance label.
+        agg = MetricsAggregator("127.0.0.1", 0)
+        series = [{"name": "jobs_total", "labels": {"session": "s"}, "value": 4.0}]
+        _push(agg, "w0", 1, counters=series)
+        _push(agg, "w1", 1, counters=[{**series[0], "value": 6.0}])
+        assert agg.statusz()["fleet"]["counters"]["jobs_total"] == 10.0
+        text = agg.render_prometheus()
+        assert 'instance="w0"' in text and 'instance="w1"' in text
+        _validate_prometheus(text)
+
+    def test_histogram_reset_does_not_double_count_buckets(self):
+        agg = MetricsAggregator("127.0.0.1", 0)
+        h = {"name": "lat_s", "labels": {}, "count": 10, "sum": 5.0,
+             "buckets": [[1.0, 8.0], ["+Inf", 10.0]]}
+        _push(agg, "w0", 1, histograms=[h])
+        _push(agg, "w0", 2, histograms=[{**h, "count": 2, "sum": 1.0,
+                                         "buckets": [[1.0, 1.0], ["+Inf", 2.0]]}])
+        text = agg.render_prometheus()
+        # count folded: 10 + 2; +Inf bucket likewise 10 + 2, not 10+10+2.
+        assert re.search(r'lat_s_count\{[^}]*\} 12\b', text), text
+        inf = [l for l in text.splitlines() if 'le="+Inf"' in l]
+        assert inf and inf[0].rstrip().endswith(" 12"), inf
+
+    def test_gauge_never_resets(self):
+        agg = MetricsAggregator("127.0.0.1", 0)
+        _push(agg, "w0", 1, gauges=[{"name": "depth", "labels": {}, "value": 9.0}])
+        _push(agg, "w0", 2, gauges=[{"name": "depth", "labels": {}, "value": 2.0}])
+        assert agg.stats()["resets_detected"] == 0
+        assert agg.statusz()["fleet"]["gauges"]["depth"] == 2.0
+
+    def test_version_skew_table(self):
+        agg = MetricsAggregator("127.0.0.1", 0)
+        bi = {"name": "build_info", "value": 1.0}
+        _push(agg, "w0", 1, gauges=[{**bi, "labels": {"version": "0.6.0"}}])
+        _push(agg, "w1", 1, gauges=[{**bi, "labels": {"version": "0.6.0"}}])
+        assert not agg.statusz()["version_skew"]["skew"]
+        _push(agg, "w2", 1, gauges=[{**bi, "labels": {"version": "0.5.0"}}])
+        skew = agg.statusz()["version_skew"]
+        assert skew["skew"] and len(skew["builds"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Wire contract.
+
+
+class TestWire:
+    def test_http_push_merge_and_409_skew(self):
+        with MetricsAggregator("127.0.0.1", 0) as agg:
+            body = json.dumps({
+                "protocol": AGG_PROTOCOL, "instance": "w0", "role": "worker",
+                "boot_id": "b", "seq": 1,
+                "metrics": {"counters": [
+                    {"name": "c", "labels": {}, "value": 2.0}]},
+            }).encode()
+            req = urllib.request.Request(
+                agg.url + "/v1/push", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+
+            stale = json.dumps({"protocol": AGG_PROTOCOL + 1, "instance": "x",
+                                "seq": 1, "metrics": {}}).encode()
+            req = urllib.request.Request(
+                agg.url + "/v1/push", data=stale,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 409
+            detail = json.loads(ei.value.read())
+            assert detail["protocol"] == AGG_PROTOCOL
+
+            with urllib.request.urlopen(agg.url + "/metrics", timeout=5) as r:
+                _validate_prometheus(r.read().decode())
+
+    def test_parse_aggregator_url(self):
+        assert (parse_aggregator_url("http://127.0.0.1:9100/")
+                == "http://127.0.0.1:9100")
+        with pytest.raises(ValueError):
+            parse_aggregator_url("ftp://x:1")
+        with pytest.raises(ValueError):
+            parse_aggregator_url("http://x:1/metrics")
+
+
+# ---------------------------------------------------------------------------
+# Pusher: fail-open degradation, refcounting.
+
+
+class TestPusher:
+    def test_exactly_one_degraded_event_per_transition(self):
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        agg = MetricsAggregator("127.0.0.1", 0).start()
+        try:
+            pusher = TelemetryPusher(agg.url, role="worker", instance="w0",
+                                     interval=60.0, cooldown=0.0, registry=reg)
+            assert pusher.push_once()
+        finally:
+            agg.stop()
+        # Aggregator gone: every retry fails but only the transition logs.
+        for _ in range(4):
+            reg.counter("c").inc()
+            pusher.push_once()
+        degraded = [r for r in sink.records
+                    if r.get("name") == "aggregator_degraded"]
+        assert len(degraded) == 1
+        assert reg.counter("aggregator_degraded_total").value == 1.0
+
+    def test_recovery_resends_full_snapshot(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(5)
+        agg = MetricsAggregator("127.0.0.1", 0).start()
+        url = agg.url
+        try:
+            pusher = TelemetryPusher(url, role="worker", instance="w0",
+                                     interval=60.0, cooldown=0.0, registry=reg)
+            assert pusher.push_once()
+            agg.stop()
+            assert not pusher.push_once()  # down → marks degraded
+        finally:
+            agg.stop()
+        port = int(url.rsplit(":", 1)[1])
+        with MetricsAggregator("127.0.0.1", port) as agg2:
+            # Nothing changed since the last successful push, but the
+            # post-failure push must resend the FULL snapshot or the new
+            # (restarted) aggregator would never learn the counter.
+            assert pusher.push_once()
+            assert agg2.statusz()["fleet"]["counters"]["c"] == 5.0
+
+    def test_acquire_pusher_refcounts_and_merges_roles(self):
+        with MetricsAggregator("127.0.0.1", 0) as agg:
+            p1 = acquire_pusher(agg.url, role="master", interval=60.0)
+            p2 = acquire_pusher(agg.url, role="broker", interval=60.0)
+            try:
+                assert p1 is p2
+                assert "master" in p1.role and "broker" in p1.role
+            finally:
+                release_pusher(p2, flush=False)
+                release_pusher(p1, flush=False)
+
+    def test_periodic_full_resend_keeps_rings_fresh(self):
+        # Quiet series must keep receiving ring points (the heartbeat full
+        # push) or a firing SLO over a flatlined series could never
+        # observe the recovery and self-clear.
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        with MetricsAggregator("127.0.0.1", 0) as agg:
+            pusher = TelemetryPusher(agg.url, role="worker", instance="w0",
+                                     interval=60.0, full_every=3,
+                                     registry=reg)
+            for _ in range(7):
+                assert pusher.push_once()
+            ring = agg.ringz(name="c", instance="w0")["series"]
+            # pushes 1 (first), 4 and 7 (heartbeats) land points even
+            # though the counter never moved after the first push.
+            assert len(ring) == 1 and len(ring[0]["points"]) == 3
+
+    def test_build_info_present_after_start(self):
+        reg = MetricsRegistry()
+        with MetricsAggregator("127.0.0.1", 0) as agg:
+            pusher = TelemetryPusher(agg.url, role="worker", instance="w0",
+                                     interval=60.0, registry=reg)
+            pusher.start()
+            try:
+                pusher.flush(timeout=5.0)
+            finally:
+                pusher.stop(flush=False)
+            labels = build_info_labels()
+            text = agg.render_prometheus()
+            assert "build_info" in text
+            assert f'version="{labels["version"]}"' in text
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: fire, self-clear, flap damping.
+
+
+def _mk_view(points_by_name):
+    def view(pattern, **_):
+        from gentun_tpu.telemetry.slo import match_series
+        return [SeriesPoints(name, {"instance": "w0", "role": "worker"}, pts)
+                for name, pts in points_by_name.items()
+                if match_series(pattern, name)]
+    return view
+
+
+class TestSloEngine:
+    RULE = SloRule(name="r", kind="increase", series="errors_total",
+                   threshold=0.0, op=">", window_s=60.0, for_s=0.0,
+                   clear_for_s=10.0, subject="fleet")
+
+    def test_fire_then_self_clear(self):
+        eng = SloEngine([self.RULE])
+        t0 = 1000.0
+        grow = [(t0 - 30, 0.0), (t0, 3.0)]
+        fired = eng.evaluate(_mk_view({"errors_total": grow}), now=t0)
+        assert [t["event"] for t in fired] == ["fire"]
+        assert eng.active()
+
+        # The window slides past the burst → healthy, but the clear hold
+        # must elapse before the alert resolves.
+        flat = [(t0 + 50, 3.0), (t0 + 65, 3.0)]
+        assert eng.evaluate(_mk_view({"errors_total": flat}), now=t0 + 65) == []
+        assert eng.active()  # clearing, not cleared
+        cleared = eng.evaluate(
+            _mk_view({"errors_total": flat + [(t0 + 80, 3.0)]}), now=t0 + 80)
+        assert [t["event"] for t in cleared] == ["clear"]
+        assert not eng.active()
+
+    def test_flap_damping_no_duplicate_fire(self):
+        eng = SloEngine([self.RULE])
+        t0 = 1000.0
+        grow = [(t0 - 30, 0.0), (t0, 1.0)]
+        assert len(eng.evaluate(_mk_view({"errors_total": grow}), now=t0)) == 1
+        # healthy for a moment (but < clear_for_s) ...
+        flat = [(t0 + 1, 1.0), (t0 + 2, 1.0)]
+        eng.evaluate(_mk_view({"errors_total": flat}), now=t0 + 2)
+        # ... then breaching again: damped — NO second fire event.
+        grow2 = flat + [(t0 + 3, 5.0)]
+        assert eng.evaluate(_mk_view({"errors_total": grow2}), now=t0 + 3) == []
+        assert len(eng.active()) == 1
+
+    def test_for_s_hold_before_firing(self):
+        rule = SloRule(name="r", kind="increase", series="errors_total",
+                       threshold=0.0, op=">", window_s=60.0, for_s=5.0,
+                       clear_for_s=1.0, subject="fleet")
+        eng = SloEngine([rule])
+        t0 = 1000.0
+        grow = [(t0 - 30, 0.0), (t0, 1.0)]
+        assert eng.evaluate(_mk_view({"errors_total": grow}), now=t0) == []
+        grow.append((t0 + 6, 2.0))
+        fired = eng.evaluate(_mk_view({"errors_total": grow}), now=t0 + 6)
+        assert [t["event"] for t in fired] == ["fire"]
+
+    def test_ratio_abstains_on_empty_denominator(self):
+        rule = SloRule(name="hit_rate", kind="ratio", series="hits_total",
+                       denom="misses_total", denom_includes_series=True,
+                       threshold=0.05, op="<", window_s=60.0, for_s=0.0,
+                       clear_for_s=1.0, subject="fleet")
+        eng = SloEngine([rule])
+        view = _mk_view({"hits_total": [(990.0, 0.0), (1000.0, 0.0)],
+                         "misses_total": [(990.0, 0.0), (1000.0, 0.0)]})
+        assert eng.evaluate(view, now=1000.0) == []
+        assert not eng.active()
+
+    def test_default_rules_scale_windows_not_thresholds(self):
+        full = {r.name: r for r in default_rules()}
+        scaled = {r.name: r for r in default_rules(scale=0.1)}
+        assert full.keys() == scaled.keys()
+        for name in full:
+            assert scaled[name].threshold == full[name].threshold
+            assert scaled[name].window_s < full[name].window_s
+
+    def test_aggregator_end_to_end_alert(self):
+        rule = SloRule(name="deg", kind="increase", series="*_degraded_total",
+                       threshold=0.0, op=">", window_s=60.0, for_s=0.0,
+                       clear_for_s=3600.0, subject="instance")
+        agg = MetricsAggregator("127.0.0.1", 0, slo_rules=[rule])
+        _push(agg, "w0", 1, counters=[
+            {"name": "fitness_service_degraded_total", "labels": {}, "value": 0.0}])
+        time.sleep(0.05)
+        _push(agg, "w0", 2, counters=[
+            {"name": "fitness_service_degraded_total", "labels": {}, "value": 1.0}])
+        fired = agg.evaluate_slos()
+        assert [t["event"] for t in fired] == ["fire"]
+        snap = agg.alertz()
+        assert snap["active"] and snap["active"][0]["rule"] == "deg"
+        assert snap["active"][0]["subject"] == "w0"
