@@ -50,7 +50,19 @@ from ..telemetry import health as _health
 from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
-from .protocol import MAX_MESSAGE_BYTES, ProtocolError, decode, encode
+from .protocol import (
+    MAX_MESSAGE_BYTES,
+    WIRE_CAPS,
+    GenomeFragmentCache,
+    JobWire,
+    ProtocolError,
+    build_job_wire,
+    decode,
+    encode,
+    jobs2_frame,
+    jobs_frame,
+    parse_caps,
+)
 from .sessions import (
     DEFAULT_SESSION,
     FairShareScheduler,
@@ -108,11 +120,12 @@ class _Worker:
 
     __slots__ = ("worker_id", "writer", "capacity", "prefetch_depth", "credit",
                  "in_flight", "last_seen", "n_chips", "backend", "draining",
-                 "mesh")
+                 "mesh", "caps")
 
     def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
                  n_chips: int = 1, backend: Optional[str] = None,
-                 prefetch_depth: int = 0, mesh: Optional[Dict[str, int]] = None):
+                 prefetch_depth: int = 0, mesh: Optional[Dict[str, int]] = None,
+                 caps: frozenset = frozenset()):
         self.worker_id = worker_id
         self.writer = writer
         self.capacity = capacity
@@ -130,6 +143,10 @@ class _Worker:
         #: worker whose capacity derives from its device mesh; None for
         #: per-chip workers (the entire pre-mesh fleet).
         self.mesh = mesh
+        #: GRANTED wire capabilities (protocol.py "Wire fast path"): the
+        #: intersection of what the worker advertised on ``hello`` and what
+        #: this broker speaks.  Empty ⇔ the v1 frame set — every old worker.
+        self.caps = caps
         #: True once the worker announced an orderly exit (elastic
         #: membership): no new dispatches, excluded from the fleet sums —
         #: but still a live connection until its in-flight results land.
@@ -209,6 +226,7 @@ class JobBroker:
         quarantine_after: int = 3,
         quarantine_crash_requeues: Optional[int] = None,
         aggregator_url: Optional[str] = None,
+        wire_caps: Optional[tuple] = None,
     ):
         self._host = host
         self._port = port
@@ -261,6 +279,20 @@ class JobBroker:
         self._job_session: Dict[str, str] = {}
         self._job_genome: Dict[str, str] = {}
         self._crash_counts: Dict[str, int] = {}
+        # Wire fast path (protocol.py "Wire fast path"): capabilities this
+        # broker grants workers, the per-master genome fragment cache, and
+        # the per-open-job wire records (popped exactly where _payloads is
+        # popped) that make every re-dispatch a byte-join instead of a
+        # re-serialization.
+        self._wire_caps = frozenset(WIRE_CAPS if wire_caps is None else wire_caps)
+        self._frag_cache = GenomeFragmentCache()
+        self._job_wire: Dict[str, JobWire] = {}
+        # Memoized wire-telemetry handles (memoize-or-die: the registry's
+        # get-or-create takes a lock per lookup; the dispatch path bumps
+        # per frame, not per job, but still holds its instruments).
+        self._wire_counters: Dict[str, tuple] = {}
+        self._encode_hist = None
+        self._encode_samples = 0
         self._workers: Dict[int, _Worker] = {}
         self._worker_seq = itertools.count()
         # Telemetry (loop-thread only): monotonic (re)enqueue stamp per open
@@ -411,16 +443,25 @@ class JobBroker:
                     f"session {sid!r} is {'closed' if sess is not None else 'unknown'}; "
                     f"open_session() it before submitting")
 
-        # Validate frame size in the CALLER's thread so an oversized payload
-        # raises where the submitter can see it, instead of being swallowed
-        # by the loop thread's best-effort writer.  Genes are tiny by design
-        # (SURVEY.md §1) — anything near the cap is a bug worth surfacing.
+        # Assemble each job's wire record in the CALLER's thread: the
+        # byte-for-byte validation pass (an oversized payload raises where
+        # the submitter can see it, instead of being swallowed by the loop
+        # thread's best-effort writer) now doubles as the ONLY serialization
+        # this job ever pays — dispatch and every requeue re-join these
+        # cached fragments (protocol.py "Wire fast path").  The genome hash
+        # moves off the loop thread with it.
+        wires: Dict[str, JobWire] = {}
+        memo: dict = {}  # batch-scoped: dedups the shared params object's dumps
         for job_id, payload in payloads.items():
-            encode({"type": "jobs", "jobs": [{"job_id": job_id, **payload}]})
+            wires[job_id] = build_job_wire(
+                job_id, payload, genome_key(payload.get("genes")),
+                self._frag_cache, memo)
 
-        self._loop.call_soon_threadsafe(self._enqueue_jobs, dict(payloads), sid)
+        self._loop.call_soon_threadsafe(
+            self._enqueue_jobs, dict(payloads), sid, wires)
 
-    def _enqueue_jobs(self, payloads: Dict[str, Dict[str, Any]], sid: str) -> None:
+    def _enqueue_jobs(self, payloads: Dict[str, Dict[str, Any]], sid: str,
+                      wires: Optional[Dict[str, JobWire]] = None) -> None:
         """Loop-thread enqueue: session books, quarantine gate, scheduler.
 
         Also the wire-client submit path (``_handle_client`` runs in the
@@ -451,7 +492,14 @@ class JobBroker:
         now = time.monotonic()
         quarantined: Dict[str, str] = {}
         for job_id, payload in payloads.items():
-            gk = genome_key(payload.get("genes"))
+            jw = wires.get(job_id) if wires is not None else None
+            if jw is None:
+                # Wire-client submits arrive without records (arbitrary
+                # dicts off the socket): build them here, loop thread.
+                jw = build_job_wire(job_id, payload,
+                                    genome_key(payload.get("genes")),
+                                    self._frag_cache)
+            gk = jw.gk
             if gk in sess.quarantine:
                 # Poison isolation: this genome already burned its failure
                 # budget in THIS session — fail instantly, never dispatch.
@@ -466,7 +514,9 @@ class JobBroker:
                 # dicts untouched either way.
                 payload = dict(payload)
                 payload["session"] = sid
+                jw = jw.with_session(sid)
             self._payloads[job_id] = payload
+            self._job_wire[job_id] = jw
             self._job_session[job_id] = sid
             self._job_genome[job_id] = gk
             self._sched.push(sid, job_id)
@@ -635,6 +685,7 @@ class JobBroker:
         ops = _health.enabled()
         for j in ids:
             self._payloads.pop(j, None)
+            self._job_wire.pop(j, None)
             self._job_session.pop(j, None)
             self._job_genome.pop(j, None)
             self._crash_counts.pop(j, None)
@@ -781,10 +832,13 @@ class JobBroker:
         owner = sess.owner
         if owner is not None:
             try:
-                owner.write(encode(frame))
-                return
+                data = encode(frame)
+                owner.write(data)
             except Exception:  # connection died; reader cleanup will detach
                 sess.owner = None
+            else:
+                self._note_wire(str(frame.get("type")), len(data))
+                return
         sess.undelivered.append(frame)
 
     def fleet_capacity(self) -> int:
@@ -882,6 +936,9 @@ class JobBroker:
             # after a final gather means a pop site was missed.
             "job_sessions": len(self._job_session),
             "crash_counts": len(self._crash_counts),
+            # Wire records share it too (encode-once fast path): a leak
+            # here would pin payload bytes past job completion.
+            "job_wires": len(self._job_wire),
         }
 
     @staticmethod
@@ -997,8 +1054,9 @@ class JobBroker:
                 break
             if w.draining:  # orderly exit in progress: never hand it work
                 continue
-            batch: List[Dict[str, Any]] = []
+            batch: List[tuple] = []  # (job_id, JobWire)
             batch_bytes = 0
+            use_jobs2 = "jobs2" in w.caps
             # Keep each frame well under the protocol cap: submit() bounds
             # single jobs, but a large-capacity worker's combined batch could
             # exceed it — flush into multiple `jobs` frames when needed (the
@@ -1065,15 +1123,25 @@ class JobBroker:
                     self._watchdog.job_started(
                         job_id, w.worker_id,
                         session=sid if sid != DEFAULT_SESSION else None)
-                entry = {"job_id": job_id, **self._payloads[job_id]}
-                entry_bytes = len(encode(entry))
+                # Encode-once fast path: the entry bytes were assembled at
+                # enqueue (or on a previous dispatch of this very job) and
+                # size the split AND join the frame — a requeued job costs
+                # zero serialization here.
+                jw = self._job_wire.get(job_id)
+                if jw is None:  # defensive: open job without a record
+                    jw = build_job_wire(job_id, self._payloads[job_id],
+                                        self._job_genome.get(job_id)
+                                        or genome_key(self._payloads[job_id].get("genes")),
+                                        self._frag_cache)
+                    self._job_wire[job_id] = jw
+                entry_bytes = len(jw.v1)
                 if batch and batch_bytes + entry_bytes > soft_cap:
-                    self._send(w, {"type": "jobs", "jobs": batch})
+                    self._flush_batch(w, batch, use_jobs2)
                     batch, batch_bytes = [], 0
-                batch.append(entry)
+                batch.append((job_id, jw))
                 batch_bytes += entry_bytes
             if batch:
-                self._send(w, {"type": "jobs", "jobs": batch})
+                self._flush_batch(w, batch, use_jobs2)
         if tele:
             self._update_flow_gauges()
 
@@ -1081,9 +1149,73 @@ class JobBroker:
         try:
             if self._injector is not None and self._injector.broker_send(w, msg):
                 return
-            w.writer.write(encode(msg))
+            data = encode(msg)
+            w.writer.write(data)
         except Exception:  # connection already broken; reader will clean up
             logger.debug("write to worker %s failed", w.worker_id, exc_info=True)
+            return
+        self._note_wire(str(msg.get("type")), len(data))
+
+    def _flush_batch(self, w: _Worker, batch: List[tuple],
+                     use_jobs2: bool) -> None:
+        """Send one dispatch batch as pre-assembled frame bytes.
+
+        v1 workers get a single ``jobs`` frame, byte-identical to the
+        pre-fast-path ``encode({"type": "jobs", "jobs": [...]})``.  A
+        ``jobs2`` worker gets one frame per distinct shared envelope — one
+        frame in the common case of a homogeneous window, and never a merge
+        of jobs that don't share their envelope.  Frame assembly is sampled
+        1-in-64 into ``frame_encode_seconds``; with a fault injector
+        installed, the typed dict the injector contracts on is recovered by
+        decoding the frame (cold path only — injectors are a test harness).
+        """
+        # 1-in-N histogram sampling: a perf_counter pair per sampled frame,
+        # a single int test otherwise (memoize-or-die, run_wire_gate).
+        self._encode_samples += 1
+        sample = (self._encode_samples & 63) == 0
+        t0 = time.perf_counter() if sample else 0.0
+        if not use_jobs2:
+            frames = [("jobs", jobs_frame([jw.v1 for _, jw in batch]))]
+        else:
+            groups: Dict[tuple, list] = {}
+            order: List[tuple] = []
+            for _, jw in batch:
+                g = groups.get(jw.env)
+                if g is None:
+                    groups[jw.env] = g = []
+                    order.append(jw.env)
+                g.append(jw.entry2)
+            frames = [("jobs2", jobs2_frame(env, groups[env])) for env in order]
+        if sample:
+            self._note_encode(time.perf_counter() - t0)
+        for mtype, data in frames:
+            try:
+                if self._injector is not None and \
+                        self._injector.broker_send(w, decode(data)):
+                    continue
+                w.writer.write(data)
+            except Exception:  # connection already broken; reader cleans up
+                logger.debug("write to worker %s failed", w.worker_id,
+                             exc_info=True)
+                continue
+            self._note_wire(mtype, len(data))
+
+    def _note_wire(self, mtype: str, nbytes: int) -> None:
+        """Bump the per-frame-type wire counters through memoized handles."""
+        handles = self._wire_counters.get(mtype)
+        if handles is None:
+            reg = _get_registry()
+            handles = (reg.counter("wire_bytes_sent_total", type=mtype),
+                       reg.counter("wire_frames_sent_total", type=mtype))
+            self._wire_counters[mtype] = handles
+        handles[0].inc(nbytes)
+        handles[1].inc()
+
+    def _note_encode(self, seconds: float) -> None:
+        if self._encode_hist is None:
+            self._encode_hist = _get_registry().histogram(
+                "frame_encode_seconds", side="broker")
+        self._encode_hist.observe(seconds)
 
     def _requeue_worker_jobs(self, w: _Worker, reason: str) -> None:
         tele = _tele.enabled()
@@ -1143,6 +1275,7 @@ class JobBroker:
         session's owner.  Loop thread only."""
         if self._payloads.pop(job_id, None) is None:
             return
+        self._job_wire.pop(job_id, None)
         sid = self._job_session.pop(job_id, DEFAULT_SESSION)
         gk = self._job_genome.pop(job_id, None)
         self._crash_counts.pop(job_id, None)
@@ -1256,10 +1389,18 @@ class JobBroker:
             "backend": w.backend,
             "draining": w.draining,
             "mesh": w.mesh,
+            "wire_caps": sorted(w.caps),
         } for w in list(self._workers.values())]
         return {
             "address": list(self._bound) if self._started.is_set() else None,
             "workers": workers,
+            # Encode-once fragment cache (protocol.py "Wire fast path"):
+            # size + hit counters for the gentun_top wire panel.
+            "fragment_cache": {
+                "entries": len(self._frag_cache),
+                "hits": self._frag_cache.hits,
+                "misses": self._frag_cache.misses,
+            },
             "members": len(workers),
             "draining": sum(1 for x in workers if x["draining"]),
             "live_capacity": self.fleet_capacity(),
@@ -1318,6 +1459,9 @@ class JobBroker:
                 backend=str(backend) if backend is not None else None,
                 prefetch_depth=self._parse_prefetch(hello, capacity),
                 mesh=self._parse_mesh(hello),
+                # Grant only capabilities BOTH ends speak; an old worker
+                # advertises nothing and lands on the v1 frame set.
+                caps=parse_caps(hello) & self._wire_caps,
             )
             # Heterogeneous-fleet check (ADVICE r3): two workers scoring one
             # generation with different estimators (e.g. xgb.cv on one host,
@@ -1341,7 +1485,13 @@ class JobBroker:
                 "prefetch_depth": worker.prefetch_depth,
                 "members": len(self._workers),
             })
-            writer.write(encode({"type": "welcome"}))
+            # Echo the GRANTED capability set so the worker knows which
+            # frames may arrive.  A caps-less worker gets the bare welcome —
+            # byte-identical to every pre-caps broker.
+            welcome: Dict[str, Any] = {"type": "welcome"}
+            if worker.caps:
+                welcome["caps"] = sorted(worker.caps)
+            writer.write(encode(welcome))
             logger.info(
                 "worker %s connected (capacity %d, prefetch %d, %d chip(s)%s)",
                 worker.worker_id, worker.capacity, worker.prefetch_depth,
@@ -1541,6 +1691,7 @@ class JobBroker:
             return False
         payload = self._payloads[job_id]
         del self._payloads[job_id]
+        self._job_wire.pop(job_id, None)
         sid = self._job_session.pop(job_id, DEFAULT_SESSION)
         self._job_genome.pop(job_id, None)
         self._crash_counts.pop(job_id, None)

@@ -37,11 +37,14 @@ from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .protocol import (
     MAX_MESSAGE_BYTES,
+    WIRE_CAPS,
     AuthError,
     ProtocolError,
     coalesce_results,
     decode,
     encode,
+    expand_jobs2,
+    parse_caps,
 )
 
 __all__ = ["GentunClient"]
@@ -165,6 +168,7 @@ class GentunClient:
         compile_cache_url: Optional[str] = None,
         aggregator_url: Optional[str] = None,
         fault_injector=None,
+        wire_caps: Optional[tuple] = None,
     ):
         self.species = species
         self.x_train = x_train
@@ -214,6 +218,17 @@ class GentunClient:
         self.reconnect_max_delay = float(reconnect_max_delay)
         self.worker_id = worker_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
         self._injector = fault_injector
+        # Wire fast path (protocol.py "Wire fast path"): capabilities this
+        # worker ADVERTISES on hello; what the broker GRANTS comes back on
+        # welcome and gates which frame types may arrive.  ``wire_caps=()``
+        # pins the v1 frame set (ops kill switch, mixed-fleet tests).
+        self._wire_caps = tuple(WIRE_CAPS if wire_caps is None else wire_caps)
+        self._broker_caps: frozenset = frozenset()
+        # Memoized wire-telemetry handles + 1-in-N encode sampling state
+        # (same memoize-or-die discipline as the broker's).
+        self._wire_counters: Dict[str, tuple] = {}
+        self._encode_hist = None
+        self._encode_samples = 0
         self._n_chips = None if n_chips is None else max(1, int(n_chips))
         self.multihost = bool(multihost)
         # Worker-side cross-run fitness reuse (VERDICT r4 weak #6): the store
@@ -431,12 +446,19 @@ class GentunClient:
             # OPTIONAL advisory field (protocol.py "Host-mesh field"):
             # old brokers ignore unknown hello keys.
             hello["mesh"] = mesh
+        if self._wire_caps:
+            # OPTIONAL capability advertisement (protocol.py "Wire fast
+            # path"): old brokers ignore it and keep speaking v1 frames.
+            hello["caps"] = list(self._wire_caps)
         self._send(hello)
         reply = self._recv()
         if reply.get("type") != "welcome":
             if reply.get("type") == "error" and reply.get("code") == "auth":
                 raise AuthError(f"broker rejected credentials: {reply.get('reason')}")
             raise ConnectionError(f"broker rejected worker: {reply}")
+        # What the broker GRANTED (old brokers grant nothing); only frames
+        # in this set may arrive, so a v1 broker never surprises us.
+        self._broker_caps = parse_caps(reply)
         self._handshaken.set()
         # A reconnect gap is downtime, not a dispatch bubble: don't let it
         # pollute the worker_idle_s histogram.
@@ -485,7 +507,30 @@ class GentunClient:
     def _send(self, msg: Dict[str, Any]) -> None:
         if self._injector is not None and self._injector.client_send(self, msg):
             return
-        self._raw_send(encode(msg))
+        # Wire telemetry mirrors the broker's: per-type byte/frame counters
+        # on every send, encode latency sampled 1-in-64 (coalesced results
+        # frames arrive pre-encoded, so the sampled cost is honest about
+        # the fast path).
+        self._encode_samples += 1
+        if (self._encode_samples & 63) == 0:
+            t0 = time.perf_counter()
+            data = encode(msg)
+            if self._encode_hist is None:
+                self._encode_hist = _get_registry().histogram(
+                    "frame_encode_seconds", side="worker")
+            self._encode_hist.observe(time.perf_counter() - t0)
+        else:
+            data = encode(msg)
+        self._raw_send(data)
+        mtype = str(msg.get("type"))
+        handles = self._wire_counters.get(mtype)
+        if handles is None:
+            reg = _get_registry()
+            handles = (reg.counter("wire_bytes_sent_total", type=mtype),
+                       reg.counter("wire_frames_sent_total", type=mtype))
+            self._wire_counters[mtype] = handles
+        handles[0].inc(len(data))
+        handles[1].inc()
 
     def _raw_send(self, data: bytes) -> None:
         with self._write_lock:
@@ -634,6 +679,10 @@ class GentunClient:
             "connected": self._handshaken.is_set(),
             "draining": self._drain_req.is_set(),
             "multihost": self.multihost,
+            # Wire fast path: advertised vs broker-granted capabilities
+            # (empty grant ⇔ a v1 broker on the other end).
+            "wire_caps": sorted(self._wire_caps),
+            "wire_caps_granted": sorted(self._broker_caps),
         }
         if self._mesh_shape is not None:
             # Host-mesh mode: the shape capacity was derived from.
@@ -813,7 +862,7 @@ class GentunClient:
             try:
                 while True:
                     msg = self._recv(rfile=rfile)
-                    if msg["type"] == "jobs":
+                    if msg["type"] in ("jobs", "jobs2"):
                         # Over-subscribed credit can coalesce up to
                         # capacity + prefetch_depth jobs into one frame;
                         # evaluate in capacity-sized (mesh-aligned)
@@ -821,8 +870,12 @@ class GentunClient:
                         # decoded, never the compiled batch shape — or a
                         # poison genome's all-or-nothing blast radius
                         # (ack-after-work failure reporting stays per
-                        # evaluation group).
-                        for chunk in self._chunk_jobs(list(msg["jobs"])):
+                        # evaluation group).  A jobs2 frame expands its
+                        # shared envelope once (protocol.py "Wire fast
+                        # path") before the same chunking.
+                        jobs = (list(msg["jobs"]) if msg["type"] == "jobs"
+                                else expand_jobs2(msg))
+                        for chunk in self._chunk_jobs(jobs):
                             ready_q.put(chunk)
                     elif msg["type"] != "welcome":
                         logger.warning("unexpected message %r", msg["type"])
@@ -916,6 +969,8 @@ class GentunClient:
             msg = self._recv()
             if msg["type"] == "jobs":
                 return list(msg["jobs"])
+            if msg["type"] == "jobs2":
+                return expand_jobs2(msg)
             # Only "welcome" (handshake replay after reconnect) is benign;
             # the broker never replies to pings.
             if msg["type"] != "welcome":
@@ -962,6 +1017,13 @@ class GentunClient:
 
         for group in groups.values():
             params = group[0].get("additional_parameters") or {}
+            # ONE defensive copy per evaluation group, shared by every
+            # individual and the Population (wire fast path: a jobs2 window
+            # already shares one decoded params object; this keeps the v1
+            # path at one copy too instead of N+1).  Evaluators treat
+            # additional_parameters as read-only — the grouping above keys
+            # on its VALUE, so a mutating evaluator was already broken.
+            shared_params = dict(params)
             individuals = []
             ok_jobs = []
             for job in group:
@@ -982,7 +1044,7 @@ class GentunClient:
                         x_train=self.x_train,
                         y_train=self.y_train,
                         genes=job["genes"],
-                        additional_parameters=dict(params),
+                        additional_parameters=shared_params,
                     )
                     individuals.append(ind)
                     ok_jobs.append(job)
@@ -996,7 +1058,7 @@ class GentunClient:
                 x_train=self.x_train,
                 y_train=self.y_train,
                 individual_list=individuals,
-                additional_parameters=dict(params),
+                additional_parameters=shared_params,
                 fitness_cache=self._store_cache,  # None ⇒ fresh per-group cache
             )
             try:
@@ -1043,7 +1105,10 @@ class GentunClient:
                             for i, job in enumerate(ok_jobs):
                                 _lineage.emit_device(
                                     share,
-                                    _lineage.genome_key(job["genes"]),
+                                    # jobs2 entries carry the broker's
+                                    # already-computed genome key; v1 jobs
+                                    # fall back to hashing locally.
+                                    job.get("gk") or _lineage.genome_key(job["genes"]),
                                     rung=(job.get("fidelity") or {}).get("rung", 0),
                                     session=str(session) if session else None,
                                     worker=self.worker_id,

@@ -188,12 +188,48 @@ independently on the broker (at-least-once semantics are unchanged), the
 group's span report rides the frame exactly as it used to ride the first
 ``result`` frame, and the single-job ``result`` frame remains accepted for
 back-compat with older workers.
+
+Wire fast path (same OPTIONAL-with-conservative-defaults convention —
+DISTRIBUTED.md "Wire fast path"):
+
+- ``hello`` may carry ``caps`` [str]: wire capabilities the worker can
+  decode beyond the v1 frame set.  The broker intersects them with its
+  own (``JobBroker(wire_caps=...)``) and echoes the GRANTED set back on
+  ``welcome`` — a capability is live only when both ends named it.  An
+  old broker ignores ``caps`` and sends a bare ``welcome``; an old
+  worker never sends ``caps`` and its ``welcome`` stays byte-identical
+  to pre-caps brokers, so mixed fleets interoperate on the v1 path with
+  zero configuration.
+- ``jobs2`` {shared: {...}, jobs: [{job_id, gk, genes, ...}, ...]}
+  (capability ``"jobs2"``): a dispatch frame that hoists the envelope
+  fields every job of the window shares — ``additional_parameters``,
+  ``fidelity``, ``trace``, ``session`` — into ONE per-frame ``shared``
+  block instead of duplicating them into every entry.  The worker
+  expands each entry as ``dict(shared)`` + per-entry overrides
+  (``expand_jobs2``), so the shared params VALUE is decoded once and
+  one object is reused across the window (evaluators treat it
+  read-only).  Each entry also carries ``gk``, the broker's
+  already-computed ``genome_key``, so the worker never re-hashes genes
+  for forensics attribution.  The broker groups a dispatch batch by
+  envelope; a heterogeneous batch degrades to one ``jobs2`` frame per
+  distinct envelope, never to an incorrect merge.
+- encode-once fragments: the master keeps a bounded
+  ``GenomeFragmentCache`` mapping ``genome_key`` → the genes' serialized
+  JSON bytes, so a genome is dumped exactly once per master lifetime and
+  every dispatch — first send, disconnect requeue, straggler speculative
+  requeue, promotion re-dispatch — reassembles its frame by joining
+  cached byte fragments (``build_job_wire``).  Assembly is byte-for-byte
+  identical to ``encode({"job_id": ..., **payload})``, which the
+  back-compat tests pin, so fault injectors and v1 workers observe
+  exactly the frames a pre-fast-path broker produced.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "encode",
@@ -202,6 +238,16 @@ __all__ = [
     "MAX_MESSAGE_BYTES",
     "ProtocolError",
     "AuthError",
+    "WIRE_CAPS",
+    "SHARED_ENVELOPE_FIELDS",
+    "parse_caps",
+    "GenomeFragmentCache",
+    "JobWire",
+    "build_job_wire",
+    "jobs_frame",
+    "jobs2_frame",
+    "expand_jobs2",
+    "PreencodedMessage",
 ]
 
 #: Hard cap per message; genes + params are a few KB, so anything huge is a
@@ -226,8 +272,31 @@ class AuthError(ConnectionError):
     """
 
 
+class PreencodedMessage(dict):
+    """A message dict that carries its own wire frame, assembled from cached
+    fragments.  ``encode()`` sends ``wire`` verbatim when set, so assemblers
+    pay serialization once while fault injectors and tests still see a typed
+    dict.  The assembler owns the invariant that ``wire`` matches the dict —
+    mutate the dict after assembly and the bytes go stale.
+    """
+
+    __slots__ = ("wire",)
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self.wire: Optional[bytes] = None
+
+
 def encode(msg: Dict[str, Any]) -> bytes:
-    """Message dict → one newline-terminated JSON frame."""
+    """Message dict → one newline-terminated JSON frame.
+
+    A :class:`PreencodedMessage` whose frame was already assembled (wire
+    fast path, ``coalesce_results``) returns its bytes without re-dumping;
+    plain dicts pay one attribute probe (~ns) and serialize as before.
+    """
+    wire = getattr(msg, "wire", None)
+    if wire is not None:
+        return wire
     data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
     if len(data) > MAX_MESSAGE_BYTES:
         raise ProtocolError(f"message of {len(data)} bytes exceeds {MAX_MESSAGE_BYTES}")
@@ -250,6 +319,240 @@ def decode(line: bytes) -> Dict[str, Any]:
     return msg
 
 
+# --------------------------------------------------------------------------
+# Wire fast path: encode-once fragments, v1/v2 frame assembly, capability
+# negotiation.  See the module docstring ("Wire fast path") and
+# DISTRIBUTED.md for the design; tests/test_protocol.py pins the
+# byte-identity invariants.
+# --------------------------------------------------------------------------
+
+#: Capabilities this build can speak beyond the v1 frame set.  Both ends
+#: default to advertising all of them; pass ``wire_caps=()`` to
+#: ``JobBroker``/``GentunClient`` to emulate a v1 peer (ops kill switch,
+#: mixed-fleet tests).
+WIRE_CAPS: Tuple[str, ...] = ("jobs2",)
+
+#: Envelope fields a ``jobs2`` frame hoists into its ``shared`` block.  The
+#: tuple order is the hoisting order; grouping is by exact serialized value,
+#: so hoisting is always lossless.
+SHARED_ENVELOPE_FIELDS: Tuple[str, ...] = (
+    "additional_parameters", "fidelity", "trace", "session")
+
+_SHARED_SET = frozenset(SHARED_ENVELOPE_FIELDS)
+
+#: Fixed framing bytes around a single-entry ``jobs`` frame — used to give
+#: submit-time oversize validation the exact byte count ``encode()`` saw.
+_JOBS_FRAME_OVERHEAD = len(b'{"type":"jobs","jobs":[]}')
+
+
+def parse_caps(msg: Dict[str, Any]) -> frozenset:
+    """The ``caps`` field of a ``hello``/``welcome`` as a frozenset of
+    strings; anything malformed degrades to "no capabilities" (the v1
+    path), never to an error — same conservative-defaults posture as
+    ``n_chips``/``mesh``."""
+    caps = msg.get("caps")
+    if not isinstance(caps, (list, tuple)):
+        return frozenset()
+    return frozenset(c for c in caps if isinstance(c, str))
+
+
+# Per-field assembly calls the serializer once per VALUE, so the fixed cost
+# of each call matters here in a way it never did for whole-frame encode():
+# a shared encoder instance skips the per-call JSONEncoder construction that
+# custom separators force on json.dumps, and plain strings (job ids, genome
+# keys, session ids) go straight to the C escaper.  Output stays
+# byte-identical to ``json.dumps(obj, separators=(",", ":"))``.
+_json_encode = json.JSONEncoder(separators=(",", ":")).encode
+_escape_str = json.encoder.encode_basestring_ascii
+
+
+def _dumps(obj: Any) -> bytes:
+    if type(obj) is str:
+        return _escape_str(obj).encode("utf-8")
+    return _json_encode(obj).encode("utf-8")
+
+
+# Payload keys come from a tiny fixed vocabulary (genes, additional_parameters,
+# fidelity, trace, session, ...), so their serialized forms are memoized —
+# per-field assembly then pays dumps() only for VALUES.
+_key_bytes_cache: Dict[str, bytes] = {}
+
+
+def _key_bytes(key: str) -> bytes:
+    b = _key_bytes_cache.get(key)
+    if b is None:
+        if len(_key_bytes_cache) > 256:  # wire vocabularies don't grow; bound anyway
+            _key_bytes_cache.clear()
+        b = _key_bytes_cache[key] = _dumps(key)
+    return b
+
+
+class GenomeFragmentCache:
+    """Bounded LRU of ``genome_key`` → the genes' serialized JSON bytes.
+
+    A genome's wire fragment is dumped exactly once per master lifetime
+    (first dispatch) and reused by every later frame assembly — requeues,
+    speculative refills, promotion re-dispatch.  Thread-safe: ``submit()``
+    builds fragments in the caller thread while the broker loop assembles
+    frames from them.  ``hits``/``misses`` are advisory totals for gates
+    and panels, not synchronization.
+    """
+
+    def __init__(self, max_entries: int = 8192) -> None:
+        self._max = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._frags: "OrderedDict[str, bytes]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def fragment(self, key: str, genes: Any) -> bytes:
+        with self._lock:
+            frag = self._frags.get(key)
+            if frag is not None:
+                self._frags.move_to_end(key)
+                self.hits += 1
+                return frag
+        frag = _dumps(genes)  # dump outside the lock; losing a race is harmless
+        with self._lock:
+            self.misses += 1
+            self._frags[key] = frag
+            while len(self._frags) > self._max:
+                self._frags.popitem(last=False)
+        return frag
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._frags)
+
+    @property
+    def max_entries(self) -> int:
+        return self._max
+
+
+class JobWire:
+    """A job's cached wire forms, built once at enqueue and reused for every
+    (re-)dispatch:
+
+    - ``v1``: the complete v1 ``jobs`` entry bytes — byte-identical to
+      ``json.dumps({"job_id": job_id, **payload}, separators=(",", ":"))``.
+    - ``entry2``: the ``jobs2`` entry bytes (job_id + gk + non-envelope
+      fields; the envelope lives in the frame's ``shared`` block).
+    - ``env``: the envelope as a hashable ``((field, value_bytes), ...)``
+      tuple — the grouping key AND the ``shared``-block fragments.
+    - ``gk``: the genome key, carried so enqueue bookkeeping (quarantine,
+      lineage, dedup) reuses the hash computed at build time.
+    """
+
+    __slots__ = ("gk", "v1", "entry2", "env")
+
+    def __init__(self, gk: str, v1: bytes, entry2: bytes,
+                 env: Tuple[Tuple[str, bytes], ...]) -> None:
+        self.gk = gk
+        self.v1 = v1
+        self.entry2 = entry2
+        self.env = env
+
+    def with_session(self, session: str) -> "JobWire":
+        """This wire record with the tenant tag appended — mirrors the
+        broker adding ``payload["session"]`` as the LAST payload key, so
+        ``v1`` stays byte-identical to the tagged dict's encoding.  The tag
+        joins the envelope, keeping ``jobs2`` grouping session-disjoint."""
+        sid_bytes = _dumps(session)
+        v1 = b"".join((self.v1[:-1], b',"session":', sid_bytes, b"}"))
+        return JobWire(self.gk, v1, self.entry2,
+                       self.env + (("session", sid_bytes),))
+
+
+def build_job_wire(job_id: str, payload: Dict[str, Any], gk: str,
+                   cache: GenomeFragmentCache,
+                   memo: Optional[Dict[int, Tuple[Any, bytes]]] = None) -> JobWire:
+    """Assemble a job's cached wire forms from fragments (one dumps() per
+    non-genes field; genes come from ``cache``).  Raises
+    :class:`ProtocolError` for a payload no single-entry frame could carry,
+    with the same byte accounting ``encode()`` would have reported — this
+    doubles as the submit-time validation pass.
+
+    ``memo`` (optional) dedups value serialization WITHIN one submit batch:
+    the master ships one shared params/fidelity object across a population's
+    payloads, so the batch pays one dumps() for it, not one per job.  Keyed
+    by ``id()`` with an identity check, and the memo holds a reference to
+    each value, so entries can't alias a recycled id.  Pass a dict scoped to
+    the batch loop — never a long-lived one (values may mutate between
+    submits).
+    """
+    fields: List[Tuple[str, bytes]] = []
+    for k, v in payload.items():
+        if k == "job_id":
+            continue  # entry position 0 below; {"job_id": ..., **payload} keeps one copy
+        if k == "genes":
+            b = cache.fragment(gk, v)
+        elif memo is not None:
+            hit = memo.get(id(v))
+            if hit is not None and hit[0] is v:
+                b = hit[1]
+            else:
+                b = _dumps(v)
+                memo[id(v)] = (v, b)
+        else:
+            b = _dumps(v)
+        fields.append((k, b))
+    jid_bytes = _dumps(payload.get("job_id", job_id))
+
+    parts = [b'{"job_id":', jid_bytes]
+    for k, b in fields:
+        parts += (b",", _key_bytes(k), b":", b)
+    parts.append(b"}")
+    v1 = b"".join(parts)
+    total = _JOBS_FRAME_OVERHEAD + len(v1)
+    if total > MAX_MESSAGE_BYTES:
+        raise ProtocolError(f"message of {total} bytes exceeds {MAX_MESSAGE_BYTES}")
+
+    parts2 = [b'{"job_id":', jid_bytes, b',"gk":', _dumps(gk)]
+    env: List[Tuple[str, bytes]] = []
+    for k, b in fields:
+        if k in _SHARED_SET:
+            env.append((k, b))
+        else:
+            parts2 += (b",", _key_bytes(k), b":", b)
+    parts2.append(b"}")
+    return JobWire(gk, v1, b"".join(parts2), tuple(env))
+
+
+def _finish_frame(body: bytes) -> bytes:
+    if len(body) > MAX_MESSAGE_BYTES:
+        raise ProtocolError(f"message of {len(body)} bytes exceeds {MAX_MESSAGE_BYTES}")
+    return body + b"\n"
+
+
+def jobs_frame(entries: Iterable[bytes]) -> bytes:
+    """Join v1 entry bytes into one ``jobs`` frame — byte-identical to
+    ``encode({"type": "jobs", "jobs": [...]})`` over the decoded entries."""
+    return _finish_frame(b'{"type":"jobs","jobs":[' + b",".join(entries) + b"]}")
+
+
+def jobs2_frame(env: Iterable[Tuple[str, bytes]],
+                entries: Iterable[bytes]) -> bytes:
+    """Join a shared envelope + ``jobs2`` entry bytes into one frame."""
+    shared = b",".join(_key_bytes(k) + b":" + v for k, v in env)
+    return _finish_frame(b'{"type":"jobs2","shared":{' + shared +
+                         b'},"jobs":[' + b",".join(entries) + b"]}")
+
+
+def expand_jobs2(msg: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """``jobs2`` frame → the v1-shaped job dicts a ``jobs`` frame would have
+    carried (plus ``gk``).  The shared envelope is decoded once by the JSON
+    layer; every expanded job references the SAME shared value objects
+    (params dict, fidelity, trace), so a capacity window holds one params
+    object, not N copies.  Per-entry keys override the envelope."""
+    shared = msg.get("shared") or {}
+    jobs: List[Dict[str, Any]] = []
+    for entry in msg.get("jobs") or ():
+        job = dict(shared)
+        job.update(entry)
+        jobs.append(job)
+    return jobs
+
+
 def coalesce_results(
     entries: List[Dict[str, Any]],
     spans: Optional[List[Dict[str, Any]]] = None,
@@ -264,25 +567,36 @@ def coalesce_results(
     group's captured telemetry report) is attached to the FIRST frame only,
     preserving the ride-the-first-result dedup contract.  Returns message
     dicts, not bytes — the client's send path owns encoding (and fault
-    injection sees typed messages).
+    injection sees typed messages).  Each entry is JSON-dumped exactly once:
+    the bytes that size the split also assemble the frame, which the
+    returned :class:`PreencodedMessage` carries for ``encode()`` to reuse.
     """
     cap = int(soft_cap) if soft_cap else MAX_MESSAGE_BYTES // 2
-    batches: List[List[Dict[str, Any]]] = []
+    batches: List[Tuple[List[Dict[str, Any]], List[bytes]]] = []
     batch: List[Dict[str, Any]] = []
+    batch_encs: List[bytes] = []
     batch_bytes = 0
     for entry in entries:
-        entry_bytes = len(json.dumps(entry, separators=(",", ":")).encode("utf-8"))
-        if batch and batch_bytes + entry_bytes > cap:
-            batches.append(batch)
-            batch, batch_bytes = [], 0
+        enc = _dumps(entry)
+        if batch and batch_bytes + len(enc) > cap:
+            batches.append((batch, batch_encs))
+            batch, batch_encs, batch_bytes = [], [], 0
         batch.append(entry)
-        batch_bytes += entry_bytes
+        batch_encs.append(enc)
+        batch_bytes += len(enc)
     if batch:
-        batches.append(batch)
+        batches.append((batch, batch_encs))
     frames: List[Dict[str, Any]] = []
-    for i, group in enumerate(batches):
-        msg: Dict[str, Any] = {"type": "results", "results": group}
+    for i, (group, encs) in enumerate(batches):
+        msg = PreencodedMessage({"type": "results", "results": group})
+        body = b'{"type":"results","results":[' + b",".join(encs) + b"]"
         if i == 0 and spans:
             msg["spans"] = spans
+            body += b',"spans":' + _dumps(spans)
+        body += b"}"
+        if len(body) <= MAX_MESSAGE_BYTES:
+            msg.wire = body + b"\n"
+        # else: wire stays None and encode() raises its usual oversize
+        # ProtocolError when the frame is actually sent — unchanged contract.
         frames.append(msg)
     return frames

@@ -39,6 +39,30 @@ __all__ = ["DistributedPopulation", "DistributedGridPopulation"]
 logger = logging.getLogger("gentun_tpu.distributed")
 
 
+def _params_copier():
+    """One defensive payload copy per DISTINCT source dict per submit call.
+
+    A population's individuals overwhelmingly share ONE
+    ``additional_parameters`` dict (the run config), yet each payload used
+    to take its own ``dict()`` copy — N copies the broker then serializes
+    into N identical wire fragments.  Memoizing the copy by source identity
+    keeps the caller-isolation contract (payloads never alias a dict the
+    caller can mutate) while giving the wire fast path one shared object
+    per config, so ``jobs2`` envelope grouping and the fragment cache see
+    maximal sharing.  id() keying is safe here: the memo only lives for one
+    submit call, during which the source individuals are referenced.
+    """
+    copies: Dict[int, Dict[str, Any]] = {}
+
+    def copy(src: Mapping[str, Any]) -> Dict[str, Any]:
+        c = copies.get(id(src))
+        if c is None:
+            c = copies[id(src)] = dict(src)
+        return c
+
+    return copy
+
+
 class DistributedPopulation(Population):
     """Population whose fitness sweep runs on remote workers.
 
@@ -368,11 +392,12 @@ class DistributedPopulation(Population):
         # Forensics opt-in rides the trace context (lineage.py): workers
         # only emit per-job device spans when the master is accounting.
         ctx = _lineage.forensic_context(ctx)
+        params_copy = _params_copier()
         for ind in individuals:
             job_id = JobBroker.new_job_id()
             payload: Dict[str, Any] = {
                 "genes": ind.get_genes(),
-                "additional_parameters": dict(ind.additional_parameters),
+                "additional_parameters": params_copy(ind.additional_parameters),
             }
             # OPTIONAL per-job fidelity tag (protocol.py): stamped by the
             # multi-fidelity engine so workers can refuse a mislabeled
@@ -501,11 +526,12 @@ class DistributedPopulation(Population):
                 self._fill_target(len(payloads)) - len(payloads), set(rep_job)
             )
             spec_ids = set()
+            params_copy = _params_copier()
             for spec in spec_inds:
                 job_id = JobBroker.new_job_id()
                 payloads[job_id] = {
                     "genes": spec.get_genes(),
-                    "additional_parameters": dict(spec.additional_parameters),
+                    "additional_parameters": params_copy(spec.additional_parameters),
                 }
                 by_id[job_id] = spec
                 spec_ids.add(job_id)
@@ -557,6 +583,7 @@ class DistributedPopulation(Population):
         by_id: Dict[str, Individual] = {}
         dup_map: Dict[str, List[Individual]] = {}
         rep_job: Dict[Any, str] = {}
+        params_copy = _params_copier()
         for ind in pending:
             key = self._safe_cache_key(ind)
             if key is not None and key in rep_job:
@@ -567,7 +594,7 @@ class DistributedPopulation(Population):
                 rep_job[key] = job_id
             payloads[job_id] = {
                 "genes": ind.get_genes(),
-                "additional_parameters": dict(ind.additional_parameters),
+                "additional_parameters": params_copy(ind.additional_parameters),
             }
             fidelity = getattr(ind, "_fidelity_tag", None)
             if fidelity is not None:

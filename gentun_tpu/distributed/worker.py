@@ -190,6 +190,11 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-plan", default=None, metavar="PATH",
                     help="chaos testing: JSON FaultPlan (distributed/faults.py) "
                          "injected into this worker's client hooks")
+    ap.add_argument("--wire-v1", action="store_true",
+                    help="advertise NO wire capabilities: pin this worker to "
+                         "the v1 frame set even against a jobs2-capable "
+                         "broker (ops kill switch for the wire fast path — "
+                         "see DISTRIBUTED.md 'Wire fast path')")
     ap.add_argument("--telemetry", action="store_true",
                     help="collect spans for evaluated job groups and ship "
                          "them to the master in result frames (equivalent to "
@@ -347,6 +352,7 @@ def main(argv=None) -> int:
             compile_cache_url=args.compile_cache_url,
             aggregator_url=args.aggregator_url,
             fault_injector=injector,
+            wire_caps=() if args.wire_v1 else None,
         )
     except ValueError as e:
         # Config errors the CLI could not pre-validate — notably a --mesh

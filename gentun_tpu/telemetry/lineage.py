@@ -90,6 +90,12 @@ __all__ = [
 # entire disabled-path cost of every lineage site.
 _ENABLED = False
 
+# genome_key runs once per submitted job on the broker's dispatch path; a
+# shared encoder instance skips the per-call JSONEncoder construction that
+# custom separators force on json.dumps.  Byte-identical output, so the
+# hash — the identity everything keys on — is unchanged.
+_canon_encode = json.JSONEncoder(sort_keys=True, separators=(",", ":")).encode
+
 
 def enabled() -> bool:
     """The one guard every lineage/cost site checks."""
@@ -121,7 +127,7 @@ def genome_key(genes: Any) -> str:
     session quarantine table re-exports as ``sessions.genome_key``.)
     """
     try:
-        blob = json.dumps(genes, sort_keys=True, separators=(",", ":"))
+        blob = _canon_encode(genes)
     except (TypeError, ValueError):
         blob = repr(genes)
     return hashlib.blake2b(blob.encode("utf-8"), digest_size=8).hexdigest()

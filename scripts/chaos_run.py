@@ -98,13 +98,14 @@ def _free_port() -> int:
 
 
 def _worker(port, injector=None, worker_id=None, species=None,
-            aggregator_url=None):
+            aggregator_url=None, wire_caps=None):
     stop = threading.Event()
     client = GentunClient(
         species or OneMax, *DATA, host="127.0.0.1", port=port,
         worker_id=worker_id,
         heartbeat_interval=0.2, reconnect_delay=0.05, reconnect_max_delay=0.5,
         fault_injector=injector, aggregator_url=aggregator_url,
+        wire_caps=wire_caps,
     )
     t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
     t.start()
@@ -951,6 +952,150 @@ def run_obs_agg() -> dict:
     }
 
 
+def run_wire_act() -> dict:
+    """Wire fast-path chaos act (DISTRIBUTED.md "Wire fast path"): the
+    encode-once dispatch plane under the two requeue paths that re-send a
+    job from its cached frame bytes — a worker disconnect mid-window and a
+    straggler speculative requeue — plus both interop postures of the caps
+    negotiation.  Three distributed searches against one clean reference,
+    all on the same seeds:
+
+    - **fast** (both workers jobs2-capable, the default): the fault plan
+      drops a ``results`` connection (the broker requeues the dead
+      worker's in-flight window) and hangs an evaluation 2.5 s past the
+      0.5 s straggler floor with ``straggler_requeue=True`` (the watchdog
+      speculatively requeues the stalled job); every re-dispatch re-joins
+      the entry bytes built once at submit.
+    - **v1** (both workers advertise no caps): the same plan through the
+      legacy ``jobs`` frames the negotiation falls back to.
+    - **mixed** (one v1 + one jobs2 worker): fault-free interop — the
+      negotiated fleet must finish with zero outstanding broker state.
+
+    Asserts every distributed trajectory is bit-identical to the clean
+    run (cached-byte re-dispatch and frame format steer nothing), both
+    fault kinds fired and the speculative requeue actually happened in
+    the fast and v1 runs, ``jobs2`` frames moved ONLY in runs with a
+    jobs2-capable worker, and no run leaked job-wire records."""
+    from gentun_tpu.telemetry.registry import get_registry
+
+    ref = GeneticAlgorithm(
+        Population(OneMax, *DATA, size=POP_SIZE, seed=POP_SEED), seed=GA_SEED)
+    ref.run(GENERATIONS)
+    ref_snap = _snapshot(ref)
+
+    def _wire_plan():
+        # Count-based like run()'s composed plan, but this fleet shifts
+        # work to the clean worker after the drop (the speculative watchdog
+        # compounds it), so wire-w0 sees only a handful of pre-evals —
+        # at=0 lands the drop on the first window, at=2 lands the hang
+        # early enough to be guaranteed an event to ride.
+        return FaultInjector(FaultPlan([
+            FaultSpec(hook="client_send", kind="drop_connection",
+                      match_type="results", at=0),
+            FaultSpec(hook="worker_pre_eval", kind="hang", at=2, duration=2.5),
+        ], seed=2026))
+
+    def _frames_by_type(snap):
+        out = {}
+        for c in snap["counters"]:
+            if c["name"] == "wire_frames_sent_total":
+                t = c["labels"].get("type", "")
+                out[t] = out.get(t, 0) + c["value"]
+        return out
+
+    def _stragglers_requeued(snap):
+        return sum(c["value"] for c in snap["counters"]
+                   if c["name"] == "stragglers_requeued_total")
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+
+    def _search(name, caps0, caps1, inject):
+        # The stall watchdog only tracks dispatches while the ops plane is
+        # live (run_stall_ops's setup), and the heartbeat reaper is pinned
+        # out so the watchdog's speculative requeue is the ONLY path that
+        # can recover the dropped window and the hang; ``straggler_k=1``
+        # keeps the threshold at the floor even after the drop's requeued
+        # round trips inflate the rolling p95.
+        inj = _wire_plan() if inject else None
+        port = _free_port()
+        flight_path = os.path.join(script_dir, f".chaos_wire_{name}_flight.jsonl")
+        start_ops_server(port=0, flight_path=flight_path)
+        before = get_registry().snapshot()
+        frames0, requeued0 = _frames_by_type(before), _stragglers_requeued(before)
+        stops = [_worker(port, injector=inj, worker_id=f"wire-w0-{name}",
+                         wire_caps=caps0),
+                 _worker(port, worker_id=f"wire-w1-{name}", wire_caps=caps1)]
+        t0 = time.monotonic()
+        try:
+            pop = DistributedPopulation(
+                OneMax, size=POP_SIZE, seed=POP_SEED, host="127.0.0.1",
+                port=port, job_timeout=120, heartbeat_timeout=30.0,
+                straggler_floor_s=0.5, straggler_k=1.0,
+                straggler_requeue=True)
+            try:
+                ga = GeneticAlgorithm(pop, seed=GA_SEED)
+                ga.run(GENERATIONS)
+                wall = time.monotonic() - t0
+                snap = _snapshot(ga)
+                leaked = pop.broker.outstanding()
+                frag = pop.broker._frag_cache
+                frag_stats = {"entries": len(frag), "hits": frag.hits,
+                              "misses": frag.misses}
+            finally:
+                pop.close()
+        finally:
+            for s in stops:
+                s.set()
+            stop_ops_server()
+            if os.path.exists(flight_path):
+                os.unlink(flight_path)
+        after = get_registry().snapshot()
+        frames1 = _frames_by_type(after)
+        frames = {t: frames1.get(t, 0) - frames0.get(t, 0)
+                  for t in frames1 if frames1.get(t, 0) > frames0.get(t, 0)}
+        assert snap == ref_snap, f"{name} run diverged from the clean run"
+        assert all(v == 0 for v in leaked.values()), (
+            f"{name} run leaked broker state: {leaked}")
+        if inject:
+            kinds = sorted({f["kind"] for f in inj.fired})
+            assert kinds == ["drop_connection", "hang"], (
+                f"{name} plan misfired: {kinds}")
+            assert _stragglers_requeued(after) - requeued0 >= 1, (
+                f"{name} hang was never speculatively requeued")
+        return {
+            "bit_identical_to_clean_run": True,
+            "faults_fired": list(inj.fired) if inj else [],
+            "stragglers_requeued": _stragglers_requeued(after) - requeued0,
+            "frames_sent": frames,
+            "fragment_cache": frag_stats,
+            "broker_state_after_final_gather": leaked,
+            "wall_s": round(wall, 3),
+        }
+
+    fast = _search("fast", None, None, inject=True)
+    v1 = _search("v1", (), (), inject=True)
+    mixed = _search("mixed", (), None, inject=False)
+
+    assert fast["frames_sent"].get("jobs2", 0) > 0, (
+        f"fast fleet never negotiated jobs2: {fast['frames_sent']}")
+    assert v1["frames_sent"].get("jobs2", 0) == 0, (
+        f"caps-less fleet was sent jobs2 frames: {v1['frames_sent']}")
+    assert mixed["frames_sent"].get("jobs2", 0) > 0 and \
+        mixed["frames_sent"].get("jobs", 0) > 0, (
+        f"mixed fleet should move both formats: {mixed['frames_sent']}")
+
+    return {
+        "generations": GENERATIONS,
+        "population_size": POP_SIZE,
+        "seeds": {"population": POP_SEED, "ga": GA_SEED},
+        "workers": 2,
+        "straggler_floor_s": 0.5,
+        "fast": fast,
+        "v1": v1,
+        "mixed": mixed,
+    }
+
+
 def run_recompile_storm() -> dict:
     """Mass-remesh compile storm with the executable cache up: fleet-wide
     compiles must collapse to ~1 per ``(pop_bucket, static-key)`` shape.
@@ -1068,6 +1213,7 @@ if __name__ == "__main__":
     out["surrogate"] = run_surrogate_act()
     out["forensics"] = run_forensics_act()
     out["recompile_storm"] = run_recompile_storm()
+    out["wire"] = run_wire_act()
     out["obs_agg"] = run_obs_agg()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")

@@ -105,6 +105,36 @@ def _parse_counters(metrics_text: str):
     return totals
 
 
+def _parse_labeled(metrics_text: str, name: str, label: str):
+    """``name{..., label="x", ...} value`` -> {x: summed value} — the
+    per-label slice the headline sum above flattens away (the wire panel
+    needs per-frame-type series, not one total)."""
+    out = {}
+    prefix = name + "{"
+    for line in metrics_text.splitlines():
+        if not line.startswith(prefix):
+            continue
+        try:
+            labels_part, value = line.rsplit(" ", 1)
+            pairs = (kv.split("=", 1) for kv in
+                     labels_part[len(prefix):].rstrip("}").split(","))
+            labels = {k: v.strip('"') for k, v in pairs}
+            key = labels.get(label)
+            if key is not None:
+                out[key] = out.get(key, 0.0) + float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
 def _fmt_age(age):
     if age is None:
         return "-"
@@ -207,6 +237,7 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                     f"{_fmt_mesh(w.get('mesh')):>7}"
                     f"{_fmt_age(w.get('last_seen_age_s')):>8}  "
                     f"{w.get('backend') or '-'}"
+                    + (f"  {Y}v1-wire{X}" if w.get("wire_caps") == [] else "")
                     + (f"  {Y}DRAINING{X}" if w.get("draining") else ""))
         for s in fleet.get("stragglers", []):
             lines.append(f"  {Y}~ straggler {s['job_id']} on {s['worker_id']} "
@@ -289,6 +320,31 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                      f"published {cc.get('published')}  "
                      f"pending-publish {cc.get('pending_publish')}  "
                      f"{D}platform {fp if fp else '-'}{X}")
+
+    # Wire panel (DISTRIBUTED.md "Wire fast path"): per-frame-type send
+    # volume from this end's wire counters (a jobs2 series means the fast
+    # path negotiated; its bytes/frame vs jobs is the hoist's saving), the
+    # sampled frame-encode cost, and the broker's fragment-cache hit rate.
+    wf = _parse_labeled(metrics_text or "", "wire_frames_sent_total", "type")
+    if wf:
+        wb = _parse_labeled(metrics_text or "", "wire_bytes_sent_total", "type")
+        parts = [f"{t} {wf[t]:g}/{_fmt_bytes(wb.get(t, 0))}"
+                 for t in sorted(wf, key=lambda t: -wb.get(t, 0))]
+        enc_sum = _parse_labeled(metrics_text or "", "frame_encode_seconds_sum",
+                                 "side")
+        enc_n = _parse_labeled(metrics_text or "", "frame_encode_seconds_count",
+                               "side")
+        enc = "  ".join(f"{D}enc[{s}] ~{enc_sum[s] / n * 1e6:.0f}us{X}"
+                        for s, n in sorted(enc_n.items()) if n)
+        lines.append(f"{B}wire{X}  " + "  ".join(parts)
+                     + (f"  {enc}" if enc else ""))
+        frag = (statusz.get("fleet") or {}).get("fragment_cache")
+        if frag:
+            lookups = (frag.get("hits", 0) or 0) + (frag.get("misses", 0) or 0)
+            rate = f"{frag['hits'] / lookups:.1%}" if lookups else "-"
+            lines.append(f"  {D}fragment cache: {frag.get('entries')} genomes, "
+                         f"hit-rate {rate} "
+                         f"({frag.get('hits')}/{lookups} lookups){X}")
 
     # Chip-hour cost panel (search forensics, docs/OBSERVABILITY.md): the
     # "cost" status provider exists only while the lineage plane is on —

@@ -2,23 +2,36 @@
 
 The socket paths are exercised end-to-end by test_distributed/test_chaos;
 this file pins the codec itself: partial frames, the size cap on both
-sides, and the ``results`` coalescing introduced for the async engine
+sides, the ``results`` coalescing introduced for the async engine
 (one frame per capacity window, split at a soft byte cap, spans riding
-the first frame only).
+the first frame only), and the wire fast path — fragment-cache
+invariants, byte-identity of fragment-assembled frames with the dict
+encoder, and ``jobs2`` capability negotiation in both mixed-version
+directions.
 """
 
 import json
+import socket
+import time
 
 import pytest
 
 from gentun_tpu.distributed.broker import JobBroker
 from gentun_tpu.distributed.protocol import (
     MAX_MESSAGE_BYTES,
+    WIRE_CAPS,
+    GenomeFragmentCache,
     ProtocolError,
+    build_job_wire,
     coalesce_results,
     decode,
     encode,
+    expand_jobs2,
+    jobs2_frame,
+    jobs_frame,
+    parse_caps,
 )
+from gentun_tpu.telemetry.lineage import genome_key
 
 
 class TestFraming:
@@ -137,3 +150,280 @@ class TestPrefetchField:
     def test_numeric_string_prefetch_accepted(self):
         # int() coercion keeps jsons from sloppy encoders working.
         assert JobBroker._parse_prefetch({"prefetch_depth": "3"}, 4) == 3
+
+def _dumps(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+class TestFragmentCache:
+    """Encode-once invariants: a hit returns the SAME bytes object the
+    first dispatch serialized, and the eviction bound holds."""
+
+    def test_hit_returns_identical_bytes(self):
+        cache = GenomeFragmentCache()
+        genes = {"S_1": [1, 0, 1], "S_2": [0, 1]}
+        first = cache.fragment("k1", genes)
+        assert first == _dumps(genes)
+        again = cache.fragment("k1", genes)
+        assert again is first  # same object — zero serialization on reuse
+        assert cache.hits == 1 and cache.misses == 1
+
+    def test_eviction_bound_honored(self):
+        cache = GenomeFragmentCache(max_entries=2)
+        for i in range(5):
+            cache.fragment(f"k{i}", {"bits": [i]})
+        assert len(cache) == 2
+        # An evicted key re-encodes to EQUAL bytes (correctness never
+        # depends on residency).
+        assert cache.fragment("k0", {"bits": [0]}) == _dumps({"bits": [0]})
+        assert len(cache) == 2
+
+    def test_insertion_order_fragment_is_authoritative(self):
+        # The cache stores the first-seen serialization; assembly must be
+        # byte-stable across repeat submits of the same genome object.
+        cache = GenomeFragmentCache()
+        genes = {"b": [1], "a": [0]}  # insertion order, not sorted order
+        frag = cache.fragment(genome_key(genes), genes)
+        assert frag == _dumps(genes)
+
+
+class TestJobWireAssembly:
+    """Fragment-assembled frames must be byte-identical to what the dict
+    encoder produced before the fast path existed — that is the whole
+    back-compat story for v1 workers and fault injectors."""
+
+    PAYLOADS = [
+        {"genes": {"S_1": [1, 0, 1, 1], "S_2": [0, 0, 1]},
+         "additional_parameters": {"nodes": [4, 4]}},
+        {"genes": {"S_1": [1]}, "additional_parameters": {"nodes": [3, 5], "lr": 0.1},
+         "fidelity": {"v": 1, "rung": 2, "fingerprint": "abc"},
+         "trace": {"trace_id": "t0", "span_id": "s0"}},
+        {"genes": {"uni": "héllo ☃"}, "additional_parameters": {},
+         "extra": [1, {"k": None, "f": 0.25}]},
+        {"genes": None},
+    ]
+
+    def test_v1_entry_byte_identity(self):
+        cache = GenomeFragmentCache()
+        for i, payload in enumerate(self.PAYLOADS):
+            jw = build_job_wire(f"job-{i}", payload, genome_key(payload.get("genes")), cache)
+            assert jw.v1 == _dumps({"job_id": f"job-{i}", **payload})
+
+    def test_session_tag_byte_identity(self):
+        cache = GenomeFragmentCache()
+        payload = self.PAYLOADS[1]
+        jw = build_job_wire("j", payload, genome_key(payload["genes"]), cache)
+        tagged = dict(payload)
+        tagged["session"] = "tenant-a"  # broker appends the tag LAST
+        assert jw.with_session("tenant-a").v1 == _dumps({"job_id": "j", **tagged})
+
+    def test_jobs_frame_byte_identity(self):
+        cache = GenomeFragmentCache()
+        wires, dicts = [], []
+        for i, payload in enumerate(self.PAYLOADS):
+            wires.append(build_job_wire(
+                f"job-{i}", payload, genome_key(payload.get("genes")), cache))
+            dicts.append({"job_id": f"job-{i}", **payload})
+        assert jobs_frame([w.v1 for w in wires]) == encode(
+            {"type": "jobs", "jobs": dicts})
+
+    def test_reassembly_after_requeue_is_byte_identical(self):
+        # The requeue contract: re-dispatch joins the SAME cached fragments,
+        # so the rebuilt frame equals the cold-encoded one bit for bit.
+        cache = GenomeFragmentCache()
+        payload = self.PAYLOADS[0]
+        jw = build_job_wire("j", payload, genome_key(payload["genes"]), cache)
+        cold = encode({"type": "jobs", "jobs": [{"job_id": "j", **payload}]})
+        for _ in range(3):  # dispatch, requeue, speculative requeue...
+            assert jobs_frame([jw.v1]) == cold
+
+    def test_jobs2_round_trip_matches_v1_jobs(self):
+        cache = GenomeFragmentCache()
+        payload = self.PAYLOADS[1]
+        gk = genome_key(payload["genes"])
+        jw = build_job_wire("j", payload, gk, cache)
+        msg = decode(jobs2_frame(jw.env, [jw.entry2]))
+        assert msg["type"] == "jobs2"
+        (job,) = expand_jobs2(msg)
+        assert job.pop("gk") == gk  # broker-computed key rides each entry
+        assert job == {"job_id": "j", **payload}
+
+    def test_jobs2_shares_one_params_object_per_window(self):
+        cache = GenomeFragmentCache()
+        payloads = [{"genes": {"b": [i]}, "additional_parameters": {"nodes": [4, 4]}}
+                    for i in range(4)]
+        wires = [build_job_wire(f"j{i}", p, genome_key(p["genes"]), cache)
+                 for i, p in enumerate(payloads)]
+        assert len({w.env for w in wires}) == 1  # one envelope group
+        jobs = expand_jobs2(decode(jobs2_frame(wires[0].env, [w.entry2 for w in wires])))
+        params = jobs[0]["additional_parameters"]
+        assert all(j["additional_parameters"] is params for j in jobs)
+
+    def test_per_entry_overrides_beat_shared(self):
+        # Decoder contract: an entry key wins over the envelope, so future
+        # delta-emitting brokers stay compatible with today's workers.
+        msg = {"type": "jobs2",
+               "shared": {"additional_parameters": {"lr": 0.1}, "session": "s"},
+               "jobs": [{"job_id": "a"},
+                        {"job_id": "b", "additional_parameters": {"lr": 0.9}}]}
+        jobs = expand_jobs2(msg)
+        assert jobs[0]["additional_parameters"] == {"lr": 0.1}
+        assert jobs[1]["additional_parameters"] == {"lr": 0.9}
+        assert jobs[0]["session"] == jobs[1]["session"] == "s"
+
+    def test_oversized_payload_raises_like_encode(self):
+        cache = GenomeFragmentCache()
+        payload = {"genes": {"blob": "x" * MAX_MESSAGE_BYTES}}
+        with pytest.raises(ProtocolError, match="exceeds"):
+            build_job_wire("j", payload, "gk", cache)
+
+
+class TestCoalesceSingleEncode:
+    def test_frame_bytes_match_dict_encoder(self):
+        entries = [{"job_id": f"j{i}", "fitness": float(i)} for i in range(8)]
+        spans = [{"kind": "eval", "dur_s": 0.1}]
+        for frames in (coalesce_results(entries),
+                       coalesce_results(entries, spans=spans),
+                       coalesce_results(entries, spans=spans, soft_cap=64)):
+            for f in frames:
+                ref = json.dumps(dict(f), separators=(",", ":")).encode() + b"\n"
+                assert encode(f) == ref
+
+    def test_encode_reuses_preassembled_bytes(self):
+        (frame,) = coalesce_results([{"job_id": "j", "fitness": 1.0}])
+        assert frame.wire is not None
+        assert encode(frame) is frame.wire  # no second dump
+
+
+class TestCapsNegotiation:
+    """jobs2 handshake in both mixed-version directions, over real
+    sockets — byte-level, because 'old worker sees frames identical to
+    today' is a byte claim, not a dict claim."""
+
+    def test_parse_caps_conservative(self):
+        assert parse_caps({"caps": ["jobs2"]}) == {"jobs2"}
+        assert parse_caps({"caps": ["jobs2", 7, None]}) == {"jobs2"}
+        assert parse_caps({"caps": "jobs2"}) == frozenset()
+        assert parse_caps({"caps": {"jobs2": True}}) == frozenset()
+        assert parse_caps({}) == frozenset()
+
+    @staticmethod
+    def _raw_worker(port, hello):
+        sock = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        rfile = sock.makefile("rb")
+        sock.sendall(encode(hello))
+        welcome_raw = rfile.readline()
+        return sock, rfile, welcome_raw
+
+    @staticmethod
+    def _payloads(n=4):
+        return {f"job-{i:02d}": {"genes": {"S_1": [i % 2, 1], "S_2": [1, i % 2]},
+                                 "additional_parameters": {"nodes": [4, 4]}}
+                for i in range(n)}
+
+    def test_old_worker_gets_byte_identical_v1_frames(self):
+        broker = JobBroker(port=0).start()
+        try:
+            port = broker.address[1]
+            payloads = self._payloads()
+            # Old worker: no caps field at all.
+            sock, rfile, welcome_raw = self._raw_worker(
+                port, {"type": "hello", "worker_id": "old", "token": None,
+                       "capacity": len(payloads)})
+            try:
+                # Pre-caps brokers sent exactly this; the echo must not
+                # leak a caps field at an old worker.
+                assert welcome_raw == encode({"type": "welcome"})
+                sock.sendall(encode({"type": "ready", "credit": len(payloads)}))
+                broker.submit(payloads)
+                frame_raw = rfile.readline()
+                expected = encode({"type": "jobs", "jobs": [
+                    {"job_id": j, **p} for j, p in payloads.items()]})
+                assert frame_raw == expected
+            finally:
+                sock.close()
+        finally:
+            broker.stop()
+
+    def test_caps_worker_negotiates_jobs2(self):
+        broker = JobBroker(port=0).start()
+        try:
+            port = broker.address[1]
+            payloads = self._payloads()
+            sock, rfile, welcome_raw = self._raw_worker(
+                port, {"type": "hello", "worker_id": "new", "token": None,
+                       "capacity": len(payloads), "caps": list(WIRE_CAPS)})
+            try:
+                assert parse_caps(decode(welcome_raw)) == {"jobs2"}
+                sock.sendall(encode({"type": "ready", "credit": len(payloads)}))
+                broker.submit(payloads)
+                msg = decode(rfile.readline())
+                assert msg["type"] == "jobs2"
+                jobs = expand_jobs2(msg)
+                got = {j["job_id"]: j for j in jobs}
+                for job_id, payload in payloads.items():
+                    job = dict(got[job_id])
+                    assert job.pop("gk") == genome_key(payload["genes"])
+                    assert job == {"job_id": job_id, **payload}
+            finally:
+                sock.close()
+        finally:
+            broker.stop()
+
+    def test_new_worker_against_v1_broker_falls_back(self):
+        # wire_caps=() emulates a pre-jobs2 broker: it grants nothing, the
+        # welcome stays bare, and dispatch speaks v1 frames.
+        broker = JobBroker(port=0, wire_caps=()).start()
+        try:
+            port = broker.address[1]
+            payloads = self._payloads()
+            sock, rfile, welcome_raw = self._raw_worker(
+                port, {"type": "hello", "worker_id": "new", "token": None,
+                       "capacity": len(payloads), "caps": list(WIRE_CAPS)})
+            try:
+                assert welcome_raw == encode({"type": "welcome"})
+                sock.sendall(encode({"type": "ready", "credit": len(payloads)}))
+                broker.submit(payloads)
+                frame_raw = rfile.readline()
+                expected = encode({"type": "jobs", "jobs": [
+                    {"job_id": j, **p} for j, p in payloads.items()]})
+                assert frame_raw == expected
+            finally:
+                sock.close()
+        finally:
+            broker.stop()
+
+    def test_disconnect_requeue_redispatches_identical_bytes(self):
+        # The cached-fragment requeue contract at the socket level: worker A
+        # dies holding the window; worker B receives the SAME frame bytes.
+        broker = JobBroker(port=0, heartbeat_timeout=30.0).start()
+        try:
+            port = broker.address[1]
+            payloads = self._payloads()
+            sock_a, rfile_a, _ = self._raw_worker(
+                port, {"type": "hello", "worker_id": "a", "token": None,
+                       "capacity": len(payloads)})
+            sock_a.sendall(encode({"type": "ready", "credit": len(payloads)}))
+            broker.submit(payloads)
+            first = rfile_a.readline()
+            # makefile() holds a second reference to the fd: close both so
+            # the FIN reaches the broker and disconnect-requeue fires.
+            rfile_a.close()
+            sock_a.close()
+            deadline = time.monotonic() + 5.0
+            while broker.outstanding()["pending"] < len(payloads):
+                assert time.monotonic() < deadline, "requeue never fired"
+                time.sleep(0.02)
+            sock_b, rfile_b, _ = self._raw_worker(
+                port, {"type": "hello", "worker_id": "b", "token": None,
+                       "capacity": len(payloads)})
+            try:
+                sock_b.sendall(encode({"type": "ready", "credit": len(payloads)}))
+                second = rfile_b.readline()
+                # Requeue preserves sorted-in-flight order == submit order
+                # here, so the whole frame matches bit for bit.
+                assert second == first
+            finally:
+                sock_b.close()
+        finally:
+            broker.stop()
