@@ -14,8 +14,17 @@ from .faults import FaultInjector, FaultPlan, FaultSpec, MasterKilled
 from .fitness_service import FitnessService, FitnessServiceClient, ServiceBackedCache
 from .protocol import AuthError
 from .server import DistributedGridPopulation, DistributedPopulation
+from .journal import (
+    JOURNAL_SCHEMA,
+    DispatchJournal,
+    JournalCorruptError,
+    JournalError,
+    JournalSchemaError,
+    replay_file,
+)
 from .sessions import (
     DEFAULT_SESSION,
+    AdmissionRejected,
     FairShareScheduler,
     SearchSession,
     SessionClient,
@@ -43,5 +52,12 @@ __all__ = [
     "SessionClient",
     "FairShareScheduler",
     "UnknownSessionError",
+    "AdmissionRejected",
+    "JOURNAL_SCHEMA",
+    "DispatchJournal",
+    "JournalError",
+    "JournalCorruptError",
+    "JournalSchemaError",
+    "replay_file",
     "genome_key",
 ]

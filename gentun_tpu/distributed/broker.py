@@ -50,6 +50,7 @@ from ..telemetry import health as _health
 from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
+from .journal import DispatchJournal, replay_file
 from .protocol import (
     MAX_MESSAGE_BYTES,
     WIRE_CAPS,
@@ -210,6 +211,29 @@ class JobBroker:
         under role ``broker`` (shared per-process pusher — a master that
         also wired the URL merges roles instead of double-counting).
         Fail-open: aggregator downtime never touches dispatch.
+    journal_path:
+        Crash safety (ISSUE 16; ``distributed/journal.py``): path of the
+        append-only dispatch journal.  :meth:`start` REPLAYS whatever is
+        there first — a restarted broker re-adopts its pre-crash sessions,
+        parked results, and open jobs (all requeued as suspect through the
+        at-least-once path) — then appends this boot's records under a
+        fresh ``boot_id``/epoch.  ``None`` (default) disables journaling
+        entirely: byte-identical wire behavior and zero hot-path cost.
+    journal_fsync_interval:
+        Batched-fsync cadence of the journal task, seconds.  Records
+        buffer in memory between fsyncs (a crash loses at most one
+        interval — safe: a lost ``c`` record only means one redundant,
+        deduplicated re-evaluation).
+    admission_rate, admission_burst:
+        Per-tenant token-bucket admission control on the WIRE tenant paths
+        (``session_open``/``submit``): sustained frames/s and burst size.
+        ``None`` (default) disables rate limiting.  In-process submits are
+        never rate-limited — a master throttling itself deadlocks.
+    admission_queue_factor:
+        Back-pressure heuristic: reject wire submits/opens with a
+        structured ``error {code:"admission", retry_after_s}`` while the
+        undispatched backlog exceeds ``factor × live fleet capacity``.
+        ``None`` (default) disables the check.
     """
 
     def __init__(
@@ -227,6 +251,11 @@ class JobBroker:
         quarantine_crash_requeues: Optional[int] = None,
         aggregator_url: Optional[str] = None,
         wire_caps: Optional[tuple] = None,
+        journal_path: Optional[str] = None,
+        journal_fsync_interval: float = 0.05,
+        admission_rate: Optional[float] = None,
+        admission_burst: Optional[float] = None,
+        admission_queue_factor: Optional[float] = None,
     ):
         self._host = host
         self._port = port
@@ -259,6 +288,29 @@ class JobBroker:
         self._watchdog_task: Optional[asyncio.Task] = None
         self._started = threading.Event()
         self._stopping = False
+
+        # Crash safety (ISSUE 16): the dispatch journal and this boot's
+        # identity.  _boot_id is None ⇔ journaling is off — the welcome
+        # frame then carries no boot_id and the epoch check never fires,
+        # byte-identical to the pre-journal broker.
+        self._journal_path = journal_path
+        self._journal_fsync_interval = max(0.005, float(journal_fsync_interval))
+        self._journal: Optional[DispatchJournal] = None
+        self._journal_task: Optional[asyncio.Task] = None
+        self._journal_counts_synced: Dict[str, int] = {}
+        self._boot_id: Optional[str] = None
+        self._epoch = 0
+        self._replay_seconds = 0.0
+        self._restarts = 0
+        # Admission control (wire tenants only): per-session token buckets
+        # (sid -> (tokens, last_refill)) plus saturation back-pressure.
+        # Loop-thread state, like the scheduler.
+        self._admission_rate = None if admission_rate is None else float(admission_rate)
+        self._admission_burst = None if admission_burst is None else float(admission_burst)
+        self._admission_queue_factor = (
+            None if admission_queue_factor is None else float(admission_queue_factor))
+        self._admission_buckets: Dict[str, tuple] = {}
+        self._admission_rejections: Dict[str, int] = {}
 
         # Loop-thread state.  A job is "open" iff its id is in _payloads:
         # the first result pops the payload, and every other path (dispatch,
@@ -327,6 +379,11 @@ class JobBroker:
         if self._thread is not None:
             return self
         self._stopping = False  # allow stop() → start() restart
+        if self._journal_path is not None and self._journal is None:
+            # Replay BEFORE the loop serves: the rebuilt state is primed
+            # single-threaded, and the first reconnecting worker already
+            # sees the re-adopted queue.
+            self._adopt_journal()
         self._thread = threading.Thread(target=self._run_loop, name="gentun-broker", daemon=True)
         self._thread.start()
         if not self._started.wait(timeout=10.0):
@@ -383,6 +440,13 @@ class JobBroker:
         self._thread = None
         self._loop = None
         self._started.clear()
+        if self._journal is not None:
+            # Clean shutdown: final batched fsync.  (kill() abandons the
+            # buffer FIRST, so a killed broker's journal truly loses its
+            # un-fsynced tail, like a real crash's.)  Dropping the handle
+            # makes the next start() replay the file afresh.
+            self._journal.close()
+            self._journal = None
         _health.unregister_watchdog(self._watchdog)
         _health.unregister_status_provider("fleet", self._ops_status)
         _health.unregister_source("broker_loop")
@@ -391,6 +455,133 @@ class JobBroker:
             release_pusher(self._pusher)
             self._pusher = None
         self._watchdog.clear()
+
+    def kill(self) -> None:
+        """In-process SIGKILL analog (chaos / HA harness): die NOW.
+
+        The journal's un-fsynced buffer is dropped on the floor first —
+        exactly what a real ``kill -9`` takes — then every TCP connection
+        and ALL loop-thread dispatch state is destroyed.  Workers see a
+        disconnect and re-enter their capped-backoff reconnect loops; wire
+        tenants likewise.  The ONLY road back is :meth:`start` replaying
+        the same ``journal_path``.  The cross-thread results channel
+        (``_results``/``_failures``/``_cond``) survives deliberately: it
+        is the MASTER's memory, and for an embedded broker the master
+        process did not die.
+        """
+        if self._journal is not None:
+            self._journal.abandon()
+        self.stop()
+        self._registry = SessionRegistry(
+            quarantine_after=self._registry.quarantine_after)
+        self._sched = FairShareScheduler(self._registry.weight)
+        self._payloads.clear()
+        self._fail_counts.clear()
+        self._job_session.clear()
+        self._job_genome.clear()
+        self._crash_counts.clear()
+        self._job_wire.clear()
+        self._frag_cache = GenomeFragmentCache()
+        self._tele_enqueued.clear()
+        self._tele_dispatched.clear()
+        self._workers.clear()
+        self._admission_buckets.clear()
+        self._journal = None
+        self._boot_id = None
+
+    def _adopt_journal(self) -> None:
+        """Replay ``journal_path`` and rebuild the pre-crash dispatch
+        state (caller thread, BEFORE the loop starts — single-threaded by
+        construction).  Every replayed open job is suspect: requeued
+        through the exact at-least-once path a worker disconnect uses,
+        with its wire bytes rebuilt through the fragment cache so a
+        re-send is byte-identical to the pre-crash dispatch."""
+        t0 = time.perf_counter()
+        state = replay_file(self._journal_path)
+        restart = state.epoch > 0
+        journal = DispatchJournal(self._journal_path,
+                                  fsync_interval=self._journal_fsync_interval,
+                                  fault_injector=self._injector)
+        journal.open(state)  # compacts to the adopted snapshot, bumps epoch
+        for sid, s in state.sessions.items():
+            sess = self._registry.open(sid, weight=s["w"],
+                                       max_in_flight=s["q"], remote=s["r"])
+            if s["closed"]:
+                # Keep the id burned: re-opening a closed session must
+                # still raise, exactly as before the crash.
+                sess.closed = True
+                continue
+            sess.quarantine |= s["quarantine"]
+            for frame in s["parked"]:
+                sess.undelivered.append(frame)
+        memo: dict = {}
+        for job_id, job in state.jobs.items():
+            payload, sid = job["p"], job["sid"]
+            gk = job["gk"] or genome_key(payload.get("genes"))
+            jw = build_job_wire(job_id, payload, gk, self._frag_cache, memo)
+            if sid != DEFAULT_SESSION:
+                payload = dict(payload)
+                payload["session"] = sid
+                jw = jw.with_session(sid)
+            self._payloads[job_id] = payload
+            self._job_wire[job_id] = jw
+            self._job_session[job_id] = sid
+            self._job_genome[job_id] = gk
+            self._sched.push(sid, job_id)
+            sess = self._registry.peek(sid)
+            if sess is not None and job["d"]:
+                sess.requeued += 1  # was in flight when the broker died
+        self._journal = journal
+        self._boot_id = journal.boot_id
+        self._epoch = journal.epoch
+        self._journal_counts_synced = {}
+        elapsed = time.perf_counter() - t0
+        self._replay_seconds = journal.replay_seconds = round(elapsed, 6)
+        reg = _get_registry()
+        reg.gauge("journal_replay_seconds").set(elapsed)
+        reg.gauge("broker_epoch").set(self._epoch)
+        if restart:
+            self._restarts += 1
+            reg.counter("broker_restarts_total").inc()
+            logger.warning(
+                "broker restarted into epoch %d from journal %s: re-adopted "
+                "%d session(s), requeued %d suspect open job(s) in %.3fs%s",
+                self._epoch, self._journal_path, len(state.sessions),
+                len(state.jobs), elapsed,
+                " (torn tail discarded)" if state.torn_tail else "")
+            _tele.record_event("broker_restarted", {
+                "epoch": self._epoch, "sessions": len(state.sessions),
+                "suspect_jobs": len(state.jobs),
+                "replay_seconds": round(elapsed, 6),
+                "torn_tail": state.torn_tail,
+            })
+
+    async def _journal_loop(self) -> None:
+        """Batched-fsync driver: ONE ``writelines+flush+fsync`` per
+        interval, whatever the dispatch rate — the hot path only appends
+        pre-formatted strings (``run_journal_gate`` holds that cost to
+        ≤ 2% of a dispatch).  Also threshold-compacts, mirrors the
+        journal's record counts into ``journal_records_total{type}``, and
+        turns an injected ``broker_crash`` into an abrupt :meth:`kill`."""
+        journal = self._journal
+        if journal is None:
+            return
+        while not self._stopping:
+            await asyncio.sleep(self._journal_fsync_interval)
+            journal.flush()
+            journal.maybe_compact()
+            if _tele.enabled():
+                reg = _get_registry()
+                for rtype, n in journal.status()["records_total"].items():
+                    seen = self._journal_counts_synced.get(rtype, 0)
+                    if n > seen:
+                        reg.counter("journal_records_total", type=rtype).inc(n - seen)
+                        self._journal_counts_synced[rtype] = n
+            if journal.crash_requested:
+                # kill() joins the loop thread — it must run elsewhere.
+                threading.Thread(target=self.kill, name="gentun-broker-crash",
+                                 daemon=True).start()
+                return
 
     def _run_loop(self) -> None:
         loop = asyncio.new_event_loop()
@@ -413,6 +604,8 @@ class JobBroker:
         self._bound = sock.getsockname()[:2]
         self._reaper_task = asyncio.ensure_future(self._reaper())
         self._watchdog_task = asyncio.ensure_future(self._watchdog_loop())
+        if self._journal is not None:
+            self._journal_task = asyncio.ensure_future(self._journal_loop())
         self._started.set()
         logger.info("broker listening on %s:%d", *self._bound)
 
@@ -489,6 +682,7 @@ class JobBroker:
                     self._cond.notify_all()
             return
         tele = _tele.enabled()
+        jrn = self._journal
         now = time.monotonic()
         quarantined: Dict[str, str] = {}
         for job_id, payload in payloads.items():
@@ -508,6 +702,10 @@ class JobBroker:
                     f"genome {gk} quarantined in session {sid!r} "
                     f"after repeated failures")
                 continue
+            if jrn is not None:
+                # Journal the UNTAGGED payload: replay re-runs this very
+                # tagging path, so the rebuilt wire bytes match exactly.
+                jrn.record_submit(job_id, sid, gk, payload)
             if sid != DEFAULT_SESSION:
                 # Tag a COPY: default-session payloads stay byte-identical
                 # to the pre-session wire format, and callers keep their
@@ -683,6 +881,10 @@ class JobBroker:
     def _cancel_ids(self, ids: Set[str]) -> None:
         """Loop-thread cancel body (also the close_session sweep)."""
         ops = _health.enabled()
+        if self._journal is not None:
+            withdrawn = sorted(j for j in ids if j in self._payloads)
+            if withdrawn:
+                self._journal.record_cancel(withdrawn)
         for j in ids:
             self._payloads.pop(j, None)
             self._job_wire.pop(j, None)
@@ -733,8 +935,22 @@ class JobBroker:
         may be dispatched at once regardless of share.  Safe from any
         thread; idempotent for an open id.
         """
-        return self._registry.open(session_id, weight=weight,
-                                   max_in_flight=max_in_flight).session_id
+        sess = self._registry.open(session_id, weight=weight,
+                                   max_in_flight=max_in_flight)
+        if self._journal is not None:
+            jrn, loop = self._journal, self._loop
+
+            def _rec(s=sess):
+                jrn.record_session_open(s.session_id, s.weight,
+                                        s.max_in_flight, s.remote)
+
+            # Journal appends belong to the loop thread; before the loop
+            # exists (pre-start adoption) the caller IS the only thread.
+            if loop is not None and self._started.is_set():
+                loop.call_soon_threadsafe(_rec)
+            else:
+                _rec()
+        return sess.session_id
 
     def close_session(self, session_id: str) -> None:
         """Close a session: no new submits, its queued jobs are withdrawn
@@ -747,6 +963,8 @@ class JobBroker:
             return
 
         def _do():
+            if self._journal is not None:
+                self._journal.record_session_close(sid)
             ids = {j for j, s in self._job_session.items() if s == sid}
             if ids:
                 self._cancel_ids(ids)
@@ -808,6 +1026,44 @@ class JobBroker:
             pre = max(0, min(pre, mine.max_in_flight - self.session_capacity(sid)))
         return pre
 
+    def _admission_check(self, sid: str,
+                         cost: float = 1.0) -> Optional[tuple]:
+        """Admission control for the WIRE tenant paths (loop thread).
+
+        Returns None to admit, else ``(reason, retry_after_s)`` — the
+        429-style verdict ``_handle_client`` turns into a structured
+        ``error {code:"admission"}`` frame.  Two independent gates:
+
+        - **saturation** (``admission_queue_factor``): while the
+          undispatched backlog exceeds ``factor × live capacity``, taking
+          more work only grows queue wait — ``retry_after_s`` estimates
+          the excess backlog's drain time at current capacity.
+        - **token bucket** (``admission_rate``/``admission_burst``): a
+          per-tenant refill-on-read bucket; ``retry_after_s`` is the exact
+          time until the needed tokens exist.
+
+        In-process submits bypass this entirely: a master throttling
+        itself would deadlock its own gather."""
+        f = self._admission_queue_factor
+        if f is not None:
+            cap = max(1, self.fleet_capacity())
+            depth = self._sched.depth()
+            if depth + cost > f * cap:
+                excess = depth + cost - f * cap
+                return "saturated", max(0.1, round(excess / cap, 3))
+        rate = self._admission_rate
+        if rate is not None and rate > 0:
+            burst = (self._admission_burst if self._admission_burst is not None
+                     else max(1.0, rate))
+            now = time.monotonic()
+            tokens, last = self._admission_buckets.get(sid, (burst, now))
+            tokens = min(burst, tokens + (now - last) * rate)
+            if tokens < cost:
+                self._admission_buckets[sid] = (tokens, now)
+                return "rate_limited", max(0.05, round((cost - tokens) / rate, 3))
+            self._admission_buckets[sid] = (tokens - cost, now)
+        return None
+
     def _inflight_by_session(self) -> Dict[str, int]:
         """Dispatched-unacked job count per session, recomputed from the
         worker table (no drift-prone counters).  Loop-thread exact; from
@@ -824,11 +1080,13 @@ class JobBroker:
                 counts[sid] = counts.get(sid, 0) + 1
         return counts
 
-    def _deliver_remote(self, sess: SearchSession, frame: Dict[str, Any]) -> None:
+    def _deliver_remote(self, sess: SearchSession, frame: Dict[str, Any]) -> bool:
         """Forward a result/fail frame to a wire tenant (loop thread).
 
         Detached (or broken) owners get the frame parked in the session's
-        bounded ``undelivered`` queue, flushed on re-attach."""
+        bounded ``undelivered`` queue, flushed on re-attach.  Returns True
+        iff the frame was written to a live owner (False ⇔ parked — the
+        journal's ``pk`` flag, so replay re-parks undelivered results)."""
         owner = sess.owner
         if owner is not None:
             try:
@@ -838,8 +1096,9 @@ class JobBroker:
                 sess.owner = None
             else:
                 self._note_wire(str(frame.get("type")), len(data))
-                return
+                return True
         sess.undelivered.append(frame)
+        return False
 
     def fleet_capacity(self) -> int:
         """Total job slots advertised by the LIVE fleet (0 when none).
@@ -1037,6 +1296,7 @@ class JobBroker:
             return
         tele = _tele.enabled()
         ops = _health.enabled()
+        jrn = self._journal
         # Quota eligibility is computed once and tracked incrementally
         # through this pass; the next _dispatch recomputes from the worker
         # table, so the count can never drift.
@@ -1072,6 +1332,10 @@ class JobBroker:
                 w.credit -= 1
                 w.in_flight.add(job_id)
                 inflight[sid] = inflight.get(sid, 0) + 1
+                if jrn is not None:
+                    # THE hot-path journal record: a pre-formatted string
+                    # append; fsync is the journal task's, never ours.
+                    jrn.record_dispatch(job_id)
                 # Size-class dispatch accounting (big-genome regime,
                 # docs/OBSERVABILITY.md): one labeled counter bump per
                 # handoff.  job_size_class is jax-free integer math on the
@@ -1245,6 +1509,8 @@ class JobBroker:
                             force_quarantine=True)
                         continue
                 logger.warning("requeue job %s (%s, worker %s)", job_id, reason, w.worker_id)
+                if self._journal is not None:
+                    self._journal.record_requeue(job_id)
                 # Disconnect redelivery is unbounded, like AMQP's.  This
                 # covers the worker's whole in-flight set — the jobs it was
                 # evaluating AND the ones still queued-but-unstarted in its
@@ -1282,13 +1548,17 @@ class JobBroker:
         self._fail_counts.pop(job_id, None)
         self._tele_enqueued.pop(job_id, None)
         self._tele_dispatched.pop(job_id, None)
+        if self._journal is not None:
+            self._journal.record_fail(job_id, reason)
         sess = self._registry.peek(sid)
         if sess is not None:
             # Quarantine bookkeeping (poison counts, counter, telemetry
             # event, lineage entry) lives with the session's books.
-            sess.record_terminal_failure(
+            newly_quarantined = sess.record_terminal_failure(
                 gk, self._registry.quarantine_after,
                 force_quarantine=force_quarantine)
+            if newly_quarantined and self._journal is not None and gk:
+                self._journal.record_quarantine(sid, gk)
         if _tele.enabled():
             self._update_flow_gauges()
         if sess is not None and sess.remote:
@@ -1349,6 +1619,8 @@ class JobBroker:
         # membership check like any redelivery duplicate.
         holder.in_flight.discard(job_id)
         sid = self._job_session.get(job_id, DEFAULT_SESSION)
+        if self._journal is not None:
+            self._journal.record_requeue(job_id)
         self._sched.push(sid, job_id)
         sess = self._registry.peek(sid)
         if sess is not None:
@@ -1417,6 +1689,18 @@ class JobBroker:
             # Tenant table (empty until the first submit/open_session):
             # per-session books for the /statusz sessions panel.
             "sessions": self.session_stats(),
+            # Crash-safety plane (ISSUE 16): journal health for the
+            # gentun_top broker panel; None ⇔ journaling off.
+            "journal": (self._journal.status()
+                        if self._journal is not None else None),
+            "epoch": self._epoch,
+            "restarts": self._restarts,
+            "admission": {
+                "rate": self._admission_rate,
+                "burst": self._admission_burst,
+                "queue_factor": self._admission_queue_factor,
+                "rejected_by_session": dict(self._admission_rejections),
+            },
         }
 
     async def _handle_worker(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -1491,6 +1775,12 @@ class JobBroker:
             welcome: Dict[str, Any] = {"type": "welcome"}
             if worker.caps:
                 welcome["caps"] = sorted(worker.caps)
+            if self._boot_id is not None:
+                # Boot identity (ISSUE 16): lets the worker stamp results
+                # with the epoch that dispatched them, so a broker restart
+                # can tell re-adopted work from truly stale echoes.  A
+                # journal-off broker stays byte-identical on the wire.
+                welcome["boot_id"] = self._boot_id
             writer.write(encode(welcome))
             logger.info(
                 "worker %s connected (capacity %d, prefetch %d, %d chip(s)%s)",
@@ -1550,10 +1840,13 @@ class JobBroker:
                     # that survives dedup, so a duplicated frame still
                     # cannot double-ingest.
                     spans = msg.get("spans")
+                    boot = msg.get("boot")
                     for entry in msg.get("results", ()):
                         e = dict(entry)
                         if spans is not None:
                             e["spans"] = spans
+                        if boot is not None:
+                            e["boot"] = boot
                         if self._on_result(worker, e):
                             spans = None
                 elif mtype == "fail":
@@ -1593,7 +1886,10 @@ class JobBroker:
         connection DETACHES the client's sessions (results park in their
         ``undelivered`` queues for re-attach); it does not close them.
         """
-        writer.write(encode({"type": "welcome"}))
+        welcome: Dict[str, Any] = {"type": "welcome"}
+        if self._boot_id is not None:
+            welcome["boot_id"] = self._boot_id
+        writer.write(encode(welcome))
         attached: Set[str] = set()
 
         def _reject(sid: Any, reason: str) -> None:
@@ -1603,6 +1899,20 @@ class JobBroker:
             writer.write(encode({"type": "error", "code": "session",
                                  "session": sid, "reason": reason}))
 
+        def _admission_reject(sid: Any, verdict: tuple) -> None:
+            # The 429 of the wire protocol: a structured, retryable
+            # rejection carrying how long to back off.  Loud counters by
+            # (session, reason) + the per-session ops tally for gentun_top.
+            sid = str(sid)
+            reason, retry_after = verdict
+            self._admission_rejections[sid] = (
+                self._admission_rejections.get(sid, 0) + 1)
+            _get_registry().counter("admission_rejected_total",
+                                    session=sid, reason=reason).inc()
+            writer.write(encode({"type": "error", "code": "admission",
+                                 "session": sid, "reason": reason,
+                                 "retry_after_s": retry_after}))
+
         try:
             while True:
                 line = await reader.readline()
@@ -1611,6 +1921,11 @@ class JobBroker:
                 msg = decode(line)
                 mtype = msg.get("type")
                 if mtype == "session_open":
+                    verdict = self._admission_check(
+                        str(msg.get("session") or "new"))
+                    if verdict is not None:
+                        _admission_reject(msg.get("session") or "new", verdict)
+                        continue
                     try:
                         weight = float(msg.get("weight", 1.0))
                     except (TypeError, ValueError):
@@ -1630,8 +1945,18 @@ class JobBroker:
                     sess.owner = writer
                     attached.add(sess.session_id)
                     # Re-attach: flush results that arrived while detached.
+                    flushed = False
                     while sess.undelivered:
                         writer.write(encode(sess.undelivered.popleft()))
+                        flushed = True
+                    if self._journal is not None:
+                        self._journal.record_session_open(
+                            sess.session_id, sess.weight,
+                            sess.max_in_flight, True)
+                        if flushed:
+                            # The parked results left the broker: replay
+                            # must not re-park them for a second delivery.
+                            self._journal.record_flush(sess.session_id)
                     writer.write(encode({"type": "session_ok",
                                          "session": sess.session_id}))
                 elif mtype == "session_detach":
@@ -1654,6 +1979,11 @@ class JobBroker:
                         if sess is not None:
                             sess.rejected += len(msg.get("jobs") or ())
                         _reject(sid, f"session {sid!r} is {state}")
+                        continue
+                    verdict = self._admission_check(
+                        sid, cost=max(1, len(msg.get("jobs") or ())))
+                    if verdict is not None:
+                        _admission_reject(sid, verdict)
                         continue
                     payloads = {}
                     for job in msg.get("jobs") or ():
@@ -1686,6 +2016,19 @@ class JobBroker:
             self._on_fail(w, {"job_id": job_id, "reason": f"malformed fitness: {msg.get('fitness')!r}"})
             return False
         w.in_flight.discard(job_id)
+        # Epoch check (ISSUE 16): a worker that survived a broker crash may
+        # deliver results for jobs dispatched by a PREVIOUS boot.  They are
+        # accepted iff the job key matches the journal-rebuilt open set
+        # (at-least-once re-adoption: exactly the result we were about to
+        # redundantly recompute) and otherwise dropped with their own
+        # counter — e.g. a job the journal shows already completed.
+        boot = msg.get("boot")
+        if (boot is not None and self._boot_id is not None
+                and boot != self._boot_id and job_id not in self._payloads):
+            logger.info("stale result for %s from broker epoch %r dropped "
+                        "(current boot %s)", job_id, boot, self._boot_id)
+            _get_registry().counter("epoch_stale_results_total").inc()
+            return False
         if job_id not in self._payloads:
             logger.info("duplicate/stale result for %s dropped (redelivery race)", job_id)
             return False
@@ -1744,13 +2087,19 @@ class JobBroker:
             if sess is None or not sess.remote:
                 self._results[job_id] = fitness
                 self._cond.notify_all()
+        delivered = True
         if sess is not None and sess.remote:
             # Wire tenant: the result belongs to the attached client, not
             # the in-process results table — forward (or park) the frame.
-            self._deliver_remote(sess, {
+            delivered = self._deliver_remote(sess, {
                 "type": "results", "session": sid,
                 "results": [{"job_id": job_id, "fitness": fitness}],
             })
+        if self._journal is not None:
+            # pk=1 ⇔ the result sits parked in the session's undelivered
+            # queue: replay must re-park it for the re-attaching owner.
+            self._journal.record_complete(job_id, fitness,
+                                          parked=not delivered)
         return True
 
     def _on_fail(self, w: _Worker, msg: Dict[str, Any]) -> None:
@@ -1771,6 +2120,8 @@ class JobBroker:
         else:
             logger.warning("job %s failed (%s); requeueing", job_id, reason)
             sid = self._job_session.get(job_id, DEFAULT_SESSION)
+            if self._journal is not None:
+                self._journal.record_requeue(job_id)
             self._sched.push(sid, job_id)
             self._tele_dispatched.pop(job_id, None)
             if _lineage.enabled():
@@ -1809,6 +2160,8 @@ class JobBroker:
                 continue  # finished/cancelled since the worker queued it
             w.in_flight.discard(job_id)
             sid = self._job_session.get(job_id, DEFAULT_SESSION)
+            if self._journal is not None:
+                self._journal.record_requeue(job_id)
             self._sched.push(sid, job_id)
             sess = self._registry.peek(sid)
             if sess is not None:
@@ -1871,3 +2224,75 @@ class JobBroker:
             "prefetch_depth": w.prefetch_depth, "mesh": w.mesh,
         })
         self._dispatch()
+
+
+def main(argv=None) -> int:
+    """Standalone broker process (``python -m gentun_tpu.distributed.broker``).
+
+    The crash-safety counterpart of the embedded broker: run it under a
+    supervisor with ``--journal``, and a restart after ``kill -9`` replays
+    to the pre-crash dispatch state — workers re-adopt through their
+    reconnect backoff, wire tenants through ``SessionClient`` re-attach.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m gentun_tpu.distributed.broker",
+        description="gentun_tpu job broker (standalone, crash-safe with --journal)",
+    )
+    ap.add_argument("--host", default="127.0.0.1", help="bind address")
+    ap.add_argument("--port", type=int, default=5672, help="bind port (0 = ephemeral)")
+    ap.add_argument("--password", default=None, help="shared token workers/tenants must present")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="dispatch journal path: replay it on boot (crash "
+                         "re-adoption), append this boot's records to it")
+    ap.add_argument("--heartbeat-timeout", type=float, default=15.0)
+    ap.add_argument("--max-attempts", type=int, default=3)
+    ap.add_argument("--admission-rate", type=float, default=None, metavar="N",
+                    help="per-tenant token-bucket rate (frames/s) on wire "
+                         "session_open/submit; unset = no rate limit")
+    ap.add_argument("--admission-burst", type=float, default=None, metavar="N",
+                    help="token-bucket burst size (default: max(1, rate))")
+    ap.add_argument("--admission-queue-factor", type=float, default=None, metavar="F",
+                    help="reject wire submits while backlog > F x live "
+                         "capacity (structured admission error with "
+                         "retry_after_s); unset = no back-pressure")
+    ap.add_argument("--aggregator-url", default=None, metavar="URL")
+    ap.add_argument("--ops-port", type=int, default=None, metavar="PORT",
+                    help="serve /metrics /healthz /statusz /alertz on "
+                         "127.0.0.1:PORT (0 = ephemeral, logged)")
+    ap.add_argument("--ops-host", default="127.0.0.1", metavar="ADDR")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    broker = JobBroker(
+        host=args.host, port=args.port, token=args.password,
+        heartbeat_timeout=args.heartbeat_timeout,
+        max_attempts=args.max_attempts,
+        aggregator_url=args.aggregator_url,
+        journal_path=args.journal,
+        admission_rate=args.admission_rate,
+        admission_burst=args.admission_burst,
+        admission_queue_factor=args.admission_queue_factor,
+    )
+    broker.start()
+    if args.ops_port is not None:
+        from ..telemetry import start_ops_server
+        start_ops_server(host=args.ops_host, port=args.ops_port)
+    logger.info("broker ready on %s:%d (epoch %d%s)", *broker.address,
+                broker._epoch, ", journal on" if args.journal else "")
+    try:
+        while True:
+            time.sleep(3600.0)
+    except KeyboardInterrupt:
+        logger.info("interrupt: stopping broker")
+    finally:
+        broker.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    raise SystemExit(main())

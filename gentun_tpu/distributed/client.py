@@ -224,6 +224,10 @@ class GentunClient:
         # pins the v1 frame set (ops kill switch, mixed-fleet tests).
         self._wire_caps = tuple(WIRE_CAPS if wire_caps is None else wire_caps)
         self._broker_caps: frozenset = frozenset()
+        # Broker boot epoch (OPTIONAL on welcome; only journaled brokers
+        # send one).  Echoed back on results/fail frames so a restarted
+        # broker can tell a live completion from a stale pre-crash one.
+        self._boot_id: Optional[str] = None
         # Memoized wire-telemetry handles + 1-in-N encode sampling state
         # (same memoize-or-die discipline as the broker's).
         self._wire_counters: Dict[str, tuple] = {}
@@ -459,6 +463,9 @@ class GentunClient:
         # What the broker GRANTED (old brokers grant nothing); only frames
         # in this set may arrive, so a v1 broker never surprises us.
         self._broker_caps = parse_caps(reply)
+        # Journaled brokers stamp their boot epoch on welcome; we echo it
+        # on every result so post-restart the new epoch can vet stale ones.
+        self._boot_id = reply.get("boot_id")
         self._handshaken.set()
         # A reconnect gap is downtime, not a dispatch bubble: don't let it
         # pollute the worker_idle_s histogram.
@@ -1140,6 +1147,10 @@ class GentunClient:
                     # The group's span report (capped well under the frame
                     # limit; spans are ~200 bytes each) rides the first frame.
                     for msg in coalesce_results(entries, spans=captured[:500] if captured else None):
+                        if self._boot_id is not None:
+                            # Epoch echo (OPTIONAL): lets a journal-restarted
+                            # broker drop results minted under a prior boot.
+                            msg["boot"] = self._boot_id
                         self._send(msg)
                     for entry in entries:
                         logger.info("job %s done: fitness %.6g", entry["job_id"], entry["fitness"])
@@ -1189,6 +1200,9 @@ class GentunClient:
         if not self._is_leader:
             return  # follower ranks hold no connection; the leader reports
         try:
-            self._send({"type": "fail", "job_id": job_id, "reason": reason[:2000]})
+            msg = {"type": "fail", "job_id": job_id, "reason": reason[:2000]}
+            if self._boot_id is not None:
+                msg["boot"] = self._boot_id
+            self._send(msg)
         except OSError:
             pass  # connection gone; broker requeues via disconnect path

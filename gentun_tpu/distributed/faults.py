@@ -21,6 +21,7 @@ Hook points and the fault kinds each supports:
 ``client_connect``    drop_connection (refuse), delay
 ``worker_pre_eval``   fail_eval, hang, delay            (per job)
 ``master_boundary``   kill_master                       (per generation)
+``journal_write``     journal_io_error, broker_crash    (per journal drain)
 ====================  ==================================================
 
 Fault kinds (the recoverable failure modes the plane is DESIGNED for —
@@ -44,6 +45,15 @@ does that; a lost frame in the real world is a broken connection):
   retransmit).  The broker must count the first only.
 - ``kill_master``     — raise :class:`MasterKilled` at a generation
   boundary.  A checkpointed search must resume bit-identically.
+- ``journal_io_error``— torn/short write on the dispatch journal: a
+  ``fraction`` prefix of the pending batch reaches the disk, then the
+  journal wedges (ISSUE 16).  Replay of the truncated tail must discard
+  the torn record loudly, never poison the fold.
+- ``broker_crash``    — the broker dies at a journal drain point WITHOUT
+  flushing (the in-process SIGKILL analog): the buffer is dropped and
+  ``DispatchJournal.crash_requested`` trips, which the broker's journal
+  task turns into an abrupt :meth:`JobBroker.kill`.  Restart-with-replay
+  must re-adopt every open job through the at-least-once path.
 
 Zero-cost when disabled: every production hook site is a single
 ``if self._injector is not None`` attribute check — no allocation, no
@@ -69,11 +79,12 @@ __all__ = [
 HOOKS = (
     "broker_send", "broker_recv", "client_send", "client_recv",
     "client_connect", "worker_pre_eval", "master_boundary",
+    "journal_write",
 )
 
 KINDS = (
     "drop_connection", "delay", "corrupt", "hang", "fail_eval",
-    "duplicate_result", "kill_master",
+    "duplicate_result", "kill_master", "journal_io_error", "broker_crash",
 )
 
 #: Which kinds make sense at which hook — validated at FaultSpec build so a
@@ -86,6 +97,7 @@ _HOOK_KINDS: Dict[str, tuple] = {
     "client_connect": ("drop_connection", "delay"),
     "worker_pre_eval": ("fail_eval", "hang", "delay"),
     "master_boundary": ("kill_master",),
+    "journal_write": ("journal_io_error", "broker_crash"),
 }
 
 #: A deliberately-invalid frame: ASCII so json sees JSONDecodeError (not
@@ -128,6 +140,9 @@ class FaultSpec:
     generation: Optional[int] = None
     delay: float = 0.05
     duration: float = 1.0
+    #: ``journal_io_error`` only: fraction of the pending batch that
+    #: reaches the disk before the torn write wedges the journal.
+    fraction: float = 0.5
 
     def __post_init__(self) -> None:
         if self.hook not in HOOKS:
@@ -369,6 +384,15 @@ class FaultInjector:
         """True while a ``hang`` fault is in force (checked by the client's
         heartbeat loop — once per interval, never per frame)."""
         return time.monotonic() < self._hang_until
+
+    # -- journal hook (runs on the broker loop thread) ---------------------
+
+    def journal_write(self, journal) -> Optional[FaultSpec]:
+        """Fires once per journal drain (the batched write point, NOT per
+        record).  Returns the matched spec — ``DispatchJournal._drain``
+        executes the torn write / crash itself, because only it knows the
+        pending bytes."""
+        return self._match("journal_write")
 
     # -- master-side hook --------------------------------------------------
 

@@ -131,6 +131,25 @@ workers and old single-tenant masters interoperate unchanged):
   field at all: the single-tenant wire format is byte-identical to
   pre-session brokers.
 
+Crash-safety fields (ISSUE 16, ``journal.py`` — same OPTIONAL convention;
+a broker running WITHOUT a dispatch journal emits none of them, keeping
+its wire format byte-identical to pre-journal brokers):
+
+- ``welcome`` (worker AND client role) may carry ``boot_id``: the
+  journaled broker's boot epoch, a fresh opaque token per process start.
+  Clients/workers that understand it echo it as ``boot`` on their
+  ``results``/``fail`` frames; old peers ignore it and echo nothing.
+- a restarted broker uses the echo to vet results minted under a PREVIOUS
+  epoch: a ``boot``-mismatched result is accepted iff its ``job_id`` is
+  still open in the replayed journal state (the work is real and wanted),
+  else dropped with ``epoch_stale_results_total`` — never double-counted.
+- ``session_open``/``submit`` over the wire may be refused under
+  admission control with a structured ``error`` {code: "admission",
+  session, reason: "saturated"|"rate_limited", retry_after_s} frame — the
+  429 contract: nothing was enqueued; back off ``retry_after_s`` seconds
+  and retry the same request.  ``SessionClient`` raises
+  :class:`~.sessions.AdmissionRejected` carrying both fields.
+
 Telemetry fields (``gentun_tpu/telemetry``, docs/OBSERVABILITY.md) — both
 OPTIONAL and only present when tracing is enabled on the sending side;
 receivers that don't understand them ignore them, so mixed

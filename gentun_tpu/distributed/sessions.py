@@ -58,6 +58,7 @@ __all__ = [
     "FairShareScheduler",
     "SessionClient",
     "UnknownSessionError",
+    "AdmissionRejected",
     "genome_key",
 ]
 
@@ -72,6 +73,20 @@ class UnknownSessionError(ValueError):
     """A submit named a session that was never opened, or one already
     closed.  Loud by design (satellite of ISSUE 8): silently dropping a
     mis-addressed job would strand its ``gather``/``wait_any`` forever."""
+
+
+class AdmissionRejected(RuntimeError):
+    """The broker refused a ``session_open``/``submit`` under admission
+    control (ISSUE 16): the fleet is saturated or this tenant exceeded
+    its token-bucket rate.  The 429-style contract: back off for
+    :attr:`retry_after_s` seconds, then retry the SAME request — nothing
+    was enqueued, so the retry is side-effect-free."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(f"admission rejected ({reason}); "
+                         f"retry after {retry_after_s:.3g}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 # Content address for a genome — canonical implementation now lives with
@@ -381,11 +396,26 @@ class SessionClient:
     ``fail`` / ``error`` frames into a condition-guarded table —
     :meth:`wait_any` mirrors ``JobBroker.wait_any`` semantics so tenant
     code reads the same whichever side of the wire it runs on.
+
+    With ``reconnect=True`` (ISSUE 16) a dropped connection — a broker
+    crash/restart, a cut link — is not fatal: the reader thread redials
+    under the same capped decorrelated backoff the worker client uses,
+    re-handshakes, and re-opens every session this client had open
+    (``session_open`` with an existing id is the broker's idempotent
+    re-attach, which also flushes any results that parked broker-side
+    during the gap).  Only jobs submitted DURING the outage are lost to
+    the caller (``submit`` raises), matching at-least-once semantics.
     """
 
     def __init__(self, host: str, port: int, token: Optional[str] = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, reconnect: bool = False,
+                 reconnect_window: float = 60.0,
+                 reconnect_max_delay: float = 5.0):
         self.host, self.port, self.token = host, int(port), token
+        self._timeout = float(timeout)
+        self._reconnect = bool(reconnect)
+        self._reconnect_window = float(reconnect_window)
+        self._reconnect_max_delay = float(reconnect_max_delay)
         self._sock = socket.create_connection((host, int(port)), timeout=timeout)
         self._sock.settimeout(None)
         self._rfile = self._sock.makefile("rb")
@@ -399,12 +429,18 @@ class SessionClient:
         self._error_seq = 0
         self._replies: Deque[Dict[str, Any]] = deque()
         self._closed = False
+        self._user_closed = False
+        #: sessions this client opened (id -> (weight, max_in_flight)) —
+        #: the re-attach worklist after a broker restart.
+        self._sessions: Dict[str, Tuple[float, Optional[int]]] = {}
         self._send({"type": "hello", "role": "client", "token": token})
         reply = self._recv_direct()
         if reply.get("type") != "welcome":
             if reply.get("type") == "error" and reply.get("code") == "auth":
                 raise AuthError(f"broker rejected client: {reply.get('reason')}")
             raise ConnectionError(f"broker rejected client: {reply}")
+        #: broker boot epoch (OPTIONAL on welcome; journaled brokers only).
+        self._boot_id: Optional[str] = reply.get("boot_id")
         self._reader = threading.Thread(target=self._read_loop,
                                         name="gentun-session-client", daemon=True)
         self._reader.start()
@@ -421,30 +457,118 @@ class SessionClient:
             raise ConnectionError("broker closed connection")
         return decode(line)
 
+    def _park(self, msg: Dict[str, Any]) -> None:
+        """File one inbound frame into the cond-guarded tables.  Caller
+        holds ``self._cond``."""
+        mtype = msg.get("type")
+        if mtype == "results":
+            for entry in msg.get("results", ()):
+                try:
+                    self._results[str(entry["job_id"])] = float(entry["fitness"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+        elif mtype == "fail":
+            self._failures[str(msg.get("job_id"))] = str(msg.get("reason", "unknown"))
+        elif mtype == "error":
+            self._errors.append(msg)
+            self._error_seq += 1
+        else:  # session_ok and friends
+            self._replies.append(msg)
+
     def _read_loop(self) -> None:
-        try:
-            while True:
-                msg = self._recv_direct()
+        while True:
+            try:
+                while True:
+                    msg = self._recv_direct()
+                    with self._cond:
+                        self._park(msg)
+                        self._cond.notify_all()
+            except (ConnectionError, OSError, ValueError):
+                pass
+            if self._user_closed or not self._reconnect or not self._reattach():
                 with self._cond:
-                    mtype = msg.get("type")
-                    if mtype == "results":
-                        for entry in msg.get("results", ()):
-                            try:
-                                self._results[str(entry["job_id"])] = float(entry["fitness"])
-                            except (KeyError, TypeError, ValueError):
-                                continue
-                    elif mtype == "fail":
-                        self._failures[str(msg.get("job_id"))] = str(msg.get("reason", "unknown"))
-                    elif mtype == "error":
-                        self._errors.append(msg)
-                        self._error_seq += 1
-                    else:  # session_ok and friends
-                        self._replies.append(msg)
+                    self._closed = True
                     self._cond.notify_all()
-        except (ConnectionError, OSError, ValueError):
-            with self._cond:
-                self._closed = True
-                self._cond.notify_all()
+                return
+
+    def _reattach(self) -> bool:
+        """Redial + re-handshake + re-open tracked sessions after the
+        connection dropped.  Runs ON the reader thread (no concurrent
+        reader exists), so the handshake reads frames directly; any
+        ``results`` flushed from broker-side parking while we wait for
+        our ``session_ok`` acks are filed into the tables, not dropped.
+        True ⇔ the client is live again."""
+        from .client import _ReconnectBackoff
+
+        backoff = _ReconnectBackoff(base=0.05,
+                                    cap=self._reconnect_max_delay,
+                                    seed=f"{self.host}:{self.port}:client")
+        deadline = time.monotonic() + self._reconnect_window
+        while not self._user_closed and time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=self._timeout)
+                sock.settimeout(self._timeout)
+                rfile = sock.makefile("rb")
+                try:
+                    sock.sendall(encode({"type": "hello", "role": "client",
+                                         "token": self.token}))
+                    reply = decode(rfile.readline(MAX_MESSAGE_BYTES + 2)
+                                   or b'{"type":"error"}')
+                    if reply.get("type") != "welcome":
+                        if (reply.get("type") == "error"
+                                and reply.get("code") == "admission"):
+                            # Saturated broker: honor the 429 contract.
+                            time.sleep(min(
+                                float(reply.get("retry_after_s") or 1.0),
+                                max(0.0, deadline - time.monotonic())))
+                            continue
+                        return False  # auth/protocol rejection — permanent
+                    for sid, (weight, mif) in list(self._sessions.items()):
+                        msg: Dict[str, Any] = {"type": "session_open",
+                                               "session": sid,
+                                               "weight": float(weight)}
+                        if mif is not None:
+                            msg["max_in_flight"] = int(mif)
+                        sock.sendall(encode(msg))
+                        while True:  # drain until THIS re-attach acks
+                            m = decode(rfile.readline(MAX_MESSAGE_BYTES + 2)
+                                       or b"")
+                            if m.get("type") == "session_ok":
+                                break
+                            if (m.get("type") == "error"
+                                    and m.get("code") == "session"
+                                    and m.get("session") == sid):
+                                # The id is closed server-side (our
+                                # session_close ack died with the link):
+                                # nothing to re-open, stop tracking it.
+                                self._sessions.pop(sid, None)
+                                break
+                            with self._cond:
+                                self._park(m)
+                                self._cond.notify_all()
+                except Exception:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise
+                sock.settimeout(None)
+                with self._wlock:
+                    old = self._sock
+                    self._sock, self._rfile = sock, rfile
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                self._boot_id = reply.get("boot_id")
+                with self._cond:
+                    self._cond.notify_all()
+                return True
+            except (ConnectionError, OSError, ValueError):
+                time.sleep(min(backoff.next_delay(),
+                               max(0.0, deadline - time.monotonic())))
+        return False
 
     def _await_reply(self, rtype: str, timeout: float = 10.0,
                      since: int = 0, session: Optional[str] = None
@@ -468,6 +592,12 @@ class SessionClient:
                                 and (session is None
                                      or msg.get("session") == session)):
                             raise UnknownSessionError(str(msg.get("reason")))
+                        if (msg.get("code") == "admission"
+                                and (session is None
+                                     or msg.get("session") == session)):
+                            raise AdmissionRejected(
+                                str(msg.get("reason", "saturated")),
+                                float(msg.get("retry_after_s") or 1.0))
                 if self._closed:
                     raise ConnectionError("broker connection lost")
                 remaining = deadline - time.monotonic()
@@ -487,15 +617,19 @@ class SessionClient:
         with self._cond:
             since = self._error_seq
         self._send(msg)
-        return str(self._await_reply(
+        sid = str(self._await_reply(
             "session_ok", since=since,
             session=str(session_id) if session_id else None)["session"])
+        self._sessions[sid] = (float(weight), None if max_in_flight is None
+                               else int(max_in_flight))
+        return sid
 
     def close_session(self, session_id: str) -> None:
         with self._cond:
             since = self._error_seq
         self._send({"type": "session_close", "session": str(session_id)})
         self._await_reply("session_ok", since=since, session=str(session_id))
+        self._sessions.pop(str(session_id), None)
 
     def detach(self, session_id: str) -> None:
         """Stop receiving this session's results (they park broker-side in
@@ -541,6 +675,7 @@ class SessionClient:
             return self._errors[-1] if self._errors else None
 
     def close(self) -> None:
+        self._user_closed = True
         try:
             self._sock.close()
         except OSError:

@@ -198,6 +198,16 @@ def default_rules(scale: float = 1.0) -> List[SloRule]:
                         "(fitness/compile cache, surrogate, aggregator)",
         ),
         SloRule(
+            name="admission_rejection_burn", kind="increase",
+            series="admission_rejected_total",
+            threshold=0.0, op=">",
+            window_s=60.0 * s, for_s=5.0 * s, clear_for_s=20.0 * s,
+            subject="fleet", severity="warn",
+            description="broker admission control rejected session_open/"
+                        "submit inside the window — fleet saturated or a "
+                        "tenant over its token-bucket rate (ISSUE 16)",
+        ),
+        SloRule(
             name="queue_depth_growth", kind="gauge_growth",
             series="session_queue_depth",
             threshold=8.0, op=">",

@@ -261,6 +261,41 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                     f"{s.get('quarantined', 0):>6}"
                     f"{s.get('rejected', 0):>5}"
                     + (f"  {Y}CLOSED{X}" if s.get("closed") else ""))
+        jrn = fleet.get("journal")
+        if jrn:
+            # Crash-safety panel (DISTRIBUTED.md "Broker crash safety &
+            # admission control"): boot epoch, journal volume, fsync
+            # recency, and what the last replay cost — the restart story
+            # at a glance.  Absent ⇔ journaling off.
+            recs = jrn.get("records_total") or {}
+            hot = "  ".join(f"{t}={recs[t]}" for t in ("sub", "d", "c", "q")
+                            if recs.get(t))
+            replay = jrn.get("replay_seconds")
+            lines.append(
+                f"{B}journal{X}  epoch {fleet.get('epoch')}  "
+                f"restarts {fleet.get('restarts', 0)}  "
+                f"records {sum(recs.values())}"
+                + (f" ({hot})" if hot else "")
+                + f"  buffered {jrn.get('records_buffered', 0)}"
+                + f"  fsync-lag {jrn.get('last_fsync_lag_s', '-')}s"
+                + (f"  replay {replay * 1e3:.0f}ms" if replay else "")
+                + (f"  {Y}WEDGED{X}" if jrn.get("wedged") else ""))
+        adm = fleet.get("admission") or {}
+        rejected = adm.get("rejected_by_session") or {}
+        if rejected:
+            # Per-tenant admission rejections: who is being turned away
+            # (429-style errors with retry_after_s), loudest first.
+            top = ", ".join(f"{sid}={n}" for sid, n in
+                            sorted(rejected.items(),
+                                   key=lambda kv: -kv[1])[:4])
+            knobs = "  ".join(
+                f"{k} {v}" for k, v in (("rate", adm.get("rate")),
+                                        ("burst", adm.get("burst")),
+                                        ("queue-factor",
+                                         adm.get("queue_factor")))
+                if v is not None)
+            lines.append(f"  {Y}admission rejected: {top}{X}"
+                         + (f"  {D}{knobs}{X}" if knobs else ""))
 
     worker = statusz.get("worker")
     if worker:

@@ -1,0 +1,565 @@
+"""Broker crash safety & admission control (ISSUE 16).
+
+Journal replay edges (empty file, torn tail, snapshot+tail compaction,
+double-requeue idempotence, schema fence), boot-epoch result fencing,
+429-style admission rejection, and the kill/restart E2E: a journaled
+broker dies mid-swarm and restarts into the exact pre-crash dispatch
+state, losing nothing and double-counting nothing.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import (
+    AdmissionRejected,
+    DispatchJournal,
+    GentunClient,
+    JobBroker,
+    JournalCorruptError,
+    JournalSchemaError,
+    SessionClient,
+    replay_file,
+)
+from gentun_tpu.distributed.faults import FaultInjector, FaultPlan, FaultSpec
+from gentun_tpu.distributed.journal import ReplayState
+from gentun_tpu.distributed.protocol import MAX_MESSAGE_BYTES, decode, encode
+from gentun_tpu.telemetry import health as _health
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    _health.disable()
+    _health.reset()
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    _health.disable()
+    _health.reset()
+    get_registry().reset()
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _counter_total(name):
+    snap = get_registry().snapshot()
+    return sum(c["value"] for c in snap["counters"] if c["name"] == name)
+
+
+def _genomes(n, seed=0):
+    pop = Population(OneMax, DATA, size=n, seed=seed, maximize=True)
+    return [ind.get_genes() for ind in pop]
+
+
+def _onemax_fitness(genes):
+    return float(sum(sum(g) for g in genes.values()))
+
+
+def _free_port():
+    """Reserve an ephemeral port number for a broker that must RESTART on
+    the same address (port=0 would rebind somewhere new)."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(species, port, worker_id, capacity=1):
+    stop = threading.Event()
+    client = GentunClient(
+        species, *DATA, host="127.0.0.1", port=port, capacity=capacity,
+        worker_id=worker_id, heartbeat_interval=0.2, reconnect_delay=0.05,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return client, stop, t
+
+
+class _RawWorker:
+    """Hand-rolled wire worker: lets a test speak exact frames (stale
+    ``boot`` echoes, unsolicited results) the real client never would."""
+
+    def __init__(self, port, worker_id="raw", capacity=1):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        self.sock.settimeout(5.0)
+        self.rfile = self.sock.makefile("rb")
+        self.send({"type": "hello", "worker_id": worker_id,
+                   "capacity": capacity})
+        self.welcome = self.recv()
+        assert self.welcome.get("type") == "welcome", self.welcome
+
+    def send(self, msg):
+        self.sock.sendall(encode(msg))
+
+    def recv(self):
+        line = self.rfile.readline(MAX_MESSAGE_BYTES + 2)
+        if not line:
+            raise ConnectionError("broker closed connection")
+        return decode(line)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Replay edges (pure file-level units)
+# ---------------------------------------------------------------------------
+
+
+class TestJournalReplay:
+    def test_missing_and_empty_files_replay_to_fresh_state(self, tmp_path):
+        p = str(tmp_path / "none.journal")
+        state = replay_file(p)
+        assert state.epoch == 0 and state.jobs == {} and state.sessions == {}
+        open(p, "w").close()  # empty file: same verdict, no torn-tail noise
+        state = replay_file(p)
+        assert state.epoch == 0 and not state.torn_tail
+        assert _counter_total("journal_torn_tail_total") == 0
+
+    def test_torn_tail_discarded_loudly(self, tmp_path):
+        p = str(tmp_path / "torn.journal")
+        with open(p, "w") as fh:
+            fh.write('{"t":"meta","schema":1,"boot":"b1","epoch":1}\n')
+            fh.write('{"t":"sub","j":"j1","sid":"default","gk":"g1",'
+                     '"p":{"genes":{"a":[1,1]}}}\n')
+            fh.write('{"t":"d","j":"j1"}\n')
+            fh.write('{"t":"c","j":"j1","f":2.')  # crash mid-append
+        state = replay_file(p)
+        assert state.torn_tail
+        # The torn completion never applied: j1 is still open + dispatched.
+        assert state.jobs["j1"]["d"] is True
+        assert _counter_total("journal_torn_tail_total") == 1
+
+    def test_complete_but_unparseable_last_line_is_torn(self, tmp_path):
+        p = str(tmp_path / "torn2.journal")
+        with open(p, "w") as fh:
+            fh.write('{"t":"meta","schema":1,"boot":"b1","epoch":1}\n')
+            fh.write('not json at all\n')
+        state = replay_file(p)
+        assert state.torn_tail and state.epoch == 1
+
+    def test_mid_file_corruption_raises(self, tmp_path):
+        p = str(tmp_path / "corrupt.journal")
+        with open(p, "w") as fh:
+            fh.write('{"t":"meta","schema":1,"boot":"b1","epoch":1}\n')
+            fh.write('garbage line\n')
+            fh.write('{"t":"d","j":"j1"}\n')
+        with pytest.raises(JournalCorruptError):
+            replay_file(p)
+
+    def test_newer_schema_refused_loudly(self, tmp_path):
+        p = str(tmp_path / "future.journal")
+        with open(p, "w") as fh:
+            fh.write('{"t":"meta","schema":99,"boot":"bf","epoch":3}\n')
+        with pytest.raises(JournalSchemaError):
+            replay_file(p)
+
+    def test_newer_snapshot_schema_refused_loudly(self, tmp_path):
+        p = str(tmp_path / "future2.journal")
+        open(p, "w").close()
+        with open(p + ".snap", "w") as fh:
+            json.dump({"schema": 99, "epoch": 3}, fh)
+        with pytest.raises(JournalSchemaError):
+            replay_file(p)
+
+    def test_snapshot_plus_tail_compaction(self, tmp_path):
+        p = str(tmp_path / "compact.journal")
+        jrn = DispatchJournal(p)
+        jrn.open()
+        jrn.record_session_open("t1", 2.0, 4, True)
+        jrn.record_submit("j1", "t1", "g1", {"genes": {"a": [1, 1]}})
+        jrn.record_dispatch("j1")
+        jrn.compact()
+        assert os.path.exists(p + ".snap")
+        # Post-compaction records land in the truncated tail; replay folds
+        # snapshot ∘ tail and must agree with the full history.
+        jrn.record_submit("j2", "t1", "g2", {"genes": {"a": [0, 1]}})
+        jrn.record_complete("j1", 3.5, parked=True)
+        jrn.close()
+        state = replay_file(p)
+        assert set(state.jobs) == {"j2"}
+        sess = state.sessions["t1"]
+        assert sess["w"] == 2.0 and sess["q"] == 4 and sess["r"] is True
+        # The parked (undelivered) result frame survives the fold:
+        assert sess["parked"] == [{
+            "type": "results", "session": "t1",
+            "results": [{"job_id": "j1", "fitness": 3.5}],
+        }]
+
+    def test_double_requeue_is_idempotent(self, tmp_path):
+        state = ReplayState()
+        for rec in (
+            {"t": "sub", "j": "j1", "sid": "default", "gk": "g1",
+             "p": {"genes": {"a": [1]}}},
+            {"t": "d", "j": "j1"},
+            {"t": "q", "j": "j1"},
+            {"t": "q", "j": "j1"},   # duplicate requeue: no second job
+            {"t": "d", "j": "j1"},
+        ):
+            state.apply(rec)
+        assert list(state.jobs) == ["j1"] and state.jobs["j1"]["d"] is True
+        # A requeue AFTER completion never resurrects the job:
+        state.apply({"t": "c", "j": "j1", "f": 1.0, "pk": 0})
+        state.apply({"t": "q", "j": "j1"})
+        assert state.jobs == {}
+
+
+# ---------------------------------------------------------------------------
+# Injected journal faults (deterministic torn writes & crashes)
+# ---------------------------------------------------------------------------
+
+
+class TestJournalFaults:
+    def test_journal_io_error_tears_write_and_wedges(self, tmp_path):
+        p = str(tmp_path / "io.journal")
+        inj = FaultInjector(FaultPlan([
+            # Drain 0 is open()'s meta flush; drain 2 tears.
+            FaultSpec(hook="journal_write", kind="journal_io_error", at=2,
+                      fraction=0.5),
+        ], seed=1))
+        jrn = DispatchJournal(p, fault_injector=inj)
+        jrn.open()
+        jrn.record_submit("j1", "default", "g1", {"genes": {"a": [1, 1]}})
+        jrn.flush()  # drain 1: durable
+        jrn.record_submit("j2", "default", "g2",
+                          {"genes": {"a": [1, 0, 1, 0, 1, 0]}})
+        jrn.flush()  # drain 2: torn at 50% of the batch, journal wedges
+        assert jrn.wedged
+        jrn.record_dispatch("j1")  # dropped: wedged journals stop writing
+        jrn.flush()
+        assert [f["kind"] for f in inj.fired] == ["journal_io_error"]
+        # Replay survives: j1 intact, the half-written j2 is a torn tail,
+        # discarded loudly — never a JournalCorruptError.
+        state = replay_file(p)
+        assert state.torn_tail
+        assert set(state.jobs) == {"j1"}
+        assert _counter_total("journal_torn_tail_total") == 1
+
+    def test_injected_broker_crash_then_journal_restart(self, tmp_path):
+        genes = _genomes(6, seed=17)
+        inj = FaultInjector(FaultPlan([
+            # Drain 0 = boot meta, drain 1 = first batch (durable),
+            # drain 2 = second batch → SIGKILL analog at the drain point.
+            FaultSpec(hook="journal_write", kind="broker_crash", at=2),
+        ], seed=1))
+        broker = JobBroker(port=_free_port(),
+                           journal_path=str(tmp_path / "crash.journal"),
+                           journal_fsync_interval=0.01,
+                           fault_injector=inj).start()
+        try:
+            broker.submit({f"a{i}": {"genes": g}
+                           for i, g in enumerate(genes[:3])})
+            # Let the journal task fsync batch 1 before provoking drain 2.
+            assert _wait(lambda: broker._journal is not None
+                         and broker._journal.status()["records_buffered"] == 0
+                         and broker._journal.status()["records_total"]
+                         .get("sub", 0) == 3)
+            broker.submit({f"b{i}": {"genes": g}
+                           for i, g in enumerate(genes[3:])})
+            # The injected crash kills the broker from its journal task.
+            assert _wait(lambda: broker._thread is None
+                         and broker._journal is None
+                         and not broker._started.is_set(), timeout=15)
+            assert [f["kind"] for f in inj.fired] == ["broker_crash"]
+            broker.start()
+            ops = broker._ops_status()
+            assert ops["epoch"] == 2 and ops["restarts"] == 1
+            # Batch 1 was fsynced → re-adopted; batch 2 died in the
+            # buffer, exactly what a real kill -9 takes.
+            assert ops["queue_depth"] == 3
+            _, port = broker.address
+            _, stop, _ = _spawn_worker(OneMax, port, "crash-w0", capacity=2)
+            try:
+                results = broker.gather([f"a{i}" for i in range(3)],
+                                        timeout=30)
+            finally:
+                stop.set()
+            assert results == {
+                f"a{i}": _onemax_fitness(g)
+                for i, g in enumerate(genes[:3])}
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Broker restart + epoch fencing (wire-level)
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerRestart:
+    def test_fresh_journal_boots_epoch_one(self, tmp_path):
+        broker = JobBroker(port=0,
+                           journal_path=str(tmp_path / "b.journal")).start()
+        try:
+            ops = broker._ops_status()
+            assert ops["epoch"] == 1 and ops["restarts"] == 0
+            assert ops["journal"]["records_total"].get("meta", 0) >= 1
+        finally:
+            broker.stop()
+        assert _counter_total("broker_restarts_total") == 0
+
+    def test_restart_requeues_open_jobs_and_preserves_results(self, tmp_path):
+        genes = _genomes(3, seed=11)
+        broker = JobBroker(port=_free_port(),
+                           journal_path=str(tmp_path / "b.journal")).start()
+        try:
+            broker.submit({f"j{i}": {"genes": g} for i, g in enumerate(genes)})
+            broker.stop()   # clean shutdown: journal fsynced + closed
+            broker.start()  # replay → epoch 2, all 3 jobs re-adopted
+            ops = broker._ops_status()
+            assert ops["epoch"] == 2 and ops["restarts"] == 1
+            assert ops["queue_depth"] == 3 and ops["open_jobs"] == 3
+            assert _counter_total("broker_restarts_total") == 1
+            _, port = broker.address
+            _, stop, _ = _spawn_worker(OneMax, port, "ha-w0", capacity=2)
+            try:
+                results = broker.gather([f"j{i}" for i in range(3)], timeout=30)
+            finally:
+                stop.set()
+            assert results == {
+                f"j{i}": _onemax_fitness(g) for i, g in enumerate(genes)}
+            assert all(v == 0 for v in broker.outstanding().values())
+        finally:
+            broker.stop()
+
+    def test_epoch_stale_result_for_unknown_job_dropped(self, tmp_path):
+        broker = JobBroker(port=0,
+                           journal_path=str(tmp_path / "b.journal")).start()
+        raw = None
+        try:
+            _, port = broker.address
+            raw = _RawWorker(port, "stale-w")
+            boot = raw.welcome.get("boot_id")
+            assert boot  # journaled broker advertises its epoch
+            raw.send({"type": "result", "job_id": "ghost", "fitness": 1.0,
+                      "boot": "previous-epoch"})
+            assert _wait(
+                lambda: _counter_total("epoch_stale_results_total") == 1)
+            with broker._cond:
+                assert "ghost" not in broker._results
+        finally:
+            if raw is not None:
+                raw.close()
+            broker.stop()
+
+    def test_stale_boot_result_for_open_job_accepted(self, tmp_path):
+        # The journal says the job is still wanted — work done under a
+        # previous epoch is real work; dropping it would waste a re-eval.
+        genes = _genomes(1, seed=12)[0]
+        broker = JobBroker(port=0,
+                           journal_path=str(tmp_path / "b.journal")).start()
+        raw = None
+        try:
+            broker.submit({"keep": {"genes": genes}})
+            _, port = broker.address
+            raw = _RawWorker(port, "old-epoch-w")
+            raw.send({"type": "ready", "credit": 1})
+            frame = raw.recv()
+            assert frame["type"] == "jobs"
+            assert frame["jobs"][0]["job_id"] == "keep"
+            raw.send({"type": "result", "job_id": "keep",
+                      "fitness": _onemax_fitness(genes),
+                      "boot": "previous-epoch"})
+            results, failures = broker.wait_any(["keep"], timeout=10)
+            assert results == {"keep": _onemax_fitness(genes)}
+            assert failures == {}
+            assert _counter_total("epoch_stale_results_total") == 0
+        finally:
+            if raw is not None:
+                raw.close()
+            broker.stop()
+
+    def test_journal_off_welcome_carries_no_boot_id(self):
+        broker = JobBroker(port=0).start()
+        raw = None
+        try:
+            _, port = broker.address
+            raw = _RawWorker(port, "plain-w")
+            assert "boot_id" not in raw.welcome
+        finally:
+            if raw is not None:
+                raw.close()
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Admission control (429 contract)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionControl:
+    def test_token_bucket_rejects_with_retry_after(self):
+        broker = JobBroker(port=0, admission_rate=0.01,
+                           admission_burst=1.0).start()
+        client = None
+        try:
+            _, port = broker.address
+            client = SessionClient("127.0.0.1", port)
+            assert client.open_session("tenant-a") == "tenant-a"  # burst token
+            with pytest.raises(AdmissionRejected) as ei:
+                client.open_session("tenant-a")
+            assert ei.value.reason == "rate_limited"
+            assert ei.value.retry_after_s > 0
+            assert _counter_total("admission_rejected_total") == 1
+            assert broker._ops_status()["admission"][
+                "rejected_by_session"] == {"tenant-a": 1}
+        finally:
+            if client is not None:
+                client.close()
+            broker.stop()
+
+    def test_saturation_rejects_submit_asynchronously(self):
+        genes = _genomes(1, seed=13)[0]
+        # No workers → live capacity clamps to 1; factor 2 → a 5-job
+        # submit (depth 0 + 5 > 2) is refused, nothing enqueued.
+        broker = JobBroker(port=0, admission_queue_factor=2.0).start()
+        client = None
+        try:
+            _, port = broker.address
+            client = SessionClient("127.0.0.1", port)
+            sid = client.open_session("tenant-s")
+            client.submit(sid, {f"s{i}": {"genes": genes} for i in range(5)})
+            assert _wait(lambda: client.last_error() is not None)
+            err = client.last_error()
+            assert err["code"] == "admission" and err["reason"] == "saturated"
+            assert err["retry_after_s"] > 0 and err["session"] == sid
+            assert broker._ops_status()["queue_depth"] == 0
+            assert _counter_total("admission_rejected_total") == 1
+        finally:
+            if client is not None:
+                client.close()
+            broker.stop()
+
+    def test_in_process_submits_bypass_admission(self):
+        genes = _genomes(1, seed=14)[0]
+        broker = JobBroker(port=0, admission_queue_factor=0.0,
+                           admission_rate=0.0001).start()
+        try:
+            # A master throttling itself would deadlock its own gather:
+            # the wire gates must never apply to in-process submits.
+            broker.submit({f"b{i}": {"genes": genes} for i in range(8)})
+            assert _wait(lambda: broker._ops_status()["queue_depth"] == 8)
+            assert _counter_total("admission_rejected_total") == 0
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# SessionClient reconnect (capped-backoff re-attach)
+# ---------------------------------------------------------------------------
+
+
+class TestSessionClientReconnect:
+    def test_client_survives_broker_kill_restart(self, tmp_path):
+        genes = _genomes(1, seed=15)[0]
+        port = _free_port()
+        broker = JobBroker(port=port,
+                           journal_path=str(tmp_path / "b.journal")).start()
+        client = None
+        worker_stop = None
+        try:
+            client = SessionClient("127.0.0.1", port, reconnect=True)
+            sid = client.open_session("phoenix", weight=2.0)
+            broker.kill()
+            broker.start()
+            assert broker._ops_status()["epoch"] == 2
+            # The reader thread redials + re-opens "phoenix".  The
+            # session_open record usually died in the un-fsynced buffer,
+            # so its reappearance in the broker's tenant table proves the
+            # client's re-attach worklist ran (not the replay).
+            assert _wait(lambda: sid in broker.session_stats(), timeout=15), \
+                "client re-attach never landed"
+            deadline = time.monotonic() + 15
+            while True:
+                try:
+                    client.submit(sid, {"p1": {"genes": genes}})
+                    break
+                except OSError:
+                    assert time.monotonic() < deadline, "reconnect never landed"
+                    time.sleep(0.05)
+            _, wport = broker.address
+            _, worker_stop, _ = _spawn_worker(OneMax, wport, "rc-w0")
+            results, failures = client.wait_any(["p1"], timeout=20)
+            assert results == {"p1": _onemax_fitness(genes)}
+            assert failures == {}
+        finally:
+            if worker_stop is not None:
+                worker_stop.set()
+            if client is not None:
+                client.close()
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Kill/restart E2E (slow): 2 workers, mid-swarm SIGKILL analog
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestKillRestartE2E:
+    def test_two_worker_kill_restart_loses_nothing(self, tmp_path):
+        n_jobs = 24
+        genes = _genomes(n_jobs, seed=16)
+        expected = {f"e{i}": _onemax_fitness(g) for i, g in enumerate(genes)}
+        port = _free_port()
+        broker = JobBroker(port=port, journal_path=str(tmp_path / "b.journal"),
+                           journal_fsync_interval=0.01).start()
+        stops = []
+        try:
+            for i in range(2):
+                _, stop, _ = _spawn_worker(OneMax, port, f"e2e-w{i}",
+                                           capacity=2)
+                stops.append(stop)
+            broker.submit({j: {"genes": g}
+                           for (j, g) in zip(expected, genes)})
+            # Let the swarm make partial progress, then die mid-flight.
+            assert _wait(lambda: len(broker._results) >= 5, timeout=20)
+            broker.kill()
+            broker.start()
+            ops = broker._ops_status()
+            assert ops["epoch"] == 2 and ops["restarts"] == 1
+            # Workers reconnect on their own backoff; every job not yet
+            # fsynced-complete was re-adopted as suspect and requeues.
+            results = broker.gather(list(expected), timeout=60)
+            assert results == expected  # zero lost, bit-identical
+            # zero double-counted: every table drained back to empty
+            assert all(v == 0 for v in broker.outstanding().values())
+            assert _counter_total("broker_restarts_total") == 1
+        finally:
+            for stop in stops:
+                stop.set()
+            broker.stop()
