@@ -1058,9 +1058,14 @@ class JobBroker:
             now = time.monotonic()
             tokens, last = self._admission_buckets.get(sid, (burst, now))
             tokens = min(burst, tokens + (now - last) * rate)
-            if tokens < cost:
+            # Debt-based bucket: a batch costing more than the burst is
+            # admitted once the bucket is FULL and drives it negative, so
+            # later requests wait out the repayment — never a retry_after_s
+            # after which the same request would still be rejected.
+            need = min(cost, burst)
+            if tokens < need:
                 self._admission_buckets[sid] = (tokens, now)
-                return "rate_limited", max(0.05, round((cost - tokens) / rate, 3))
+                return "rate_limited", max(0.05, round((need - tokens) / rate, 3))
             self._admission_buckets[sid] = (tokens - cost, now)
         return None
 

@@ -63,7 +63,9 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
+import re
 import threading
 import time
 import uuid
@@ -90,6 +92,30 @@ JOURNAL_SCHEMA = 1
 
 #: Record types, for the ``journal_records_total{type}`` counter family.
 RECORD_TYPES = ("meta", "so", "sc", "sub", "d", "c", "fl", "x", "q", "cx", "g")
+
+#: Ids safe to splice into a hand-built record verbatim.  Broker-minted
+#: ids (uuid hex) always match; anything else — job/session ids are
+#: caller- and wire-provided arbitrary strings — takes the ``json.dumps``
+#: path below so a quote, backslash, or newline can never tear a journal
+#: line (or forge extra records).
+_PLAIN_ID = re.compile(r"[A-Za-z0-9_.\-]*\Z").match
+
+
+def _jid(s: str) -> str:
+    """JSON-quote an id for a hand-built record (see :data:`_PLAIN_ID`)."""
+    return '"%s"' % s if _PLAIN_ID(s) else json.dumps(s)
+
+
+def _jfloat(f: float) -> str:
+    """JSON-format a fitness.  ``repr`` of a non-finite float is bare
+    ``nan``/``inf``, which ``json.loads`` rejects — so non-finite values
+    are journaled as quoted strings and restored to float on replay."""
+    return repr(f) if math.isfinite(f) else '"%s"' % repr(f)
+
+
+def _unjfloat(f: Any) -> Any:
+    """Inverse of :func:`_jfloat` for replayed ``c`` records."""
+    return float(f) if isinstance(f, str) else f
 
 
 class JournalError(RuntimeError):
@@ -196,7 +222,7 @@ class ReplayState:
                     sess["parked"].append({
                         "type": "results", "session": job["sid"],
                         "results": [{"job_id": str(rec.get("j")),
-                                     "fitness": rec.get("f")}],
+                                     "fitness": _unjfloat(rec.get("f"))}],
                     })
         elif t == "fl":
             self._session(str(rec["sid"]))["parked"] = []
@@ -388,7 +414,7 @@ class DispatchJournal:
     def record_dispatch(self, job_id: str) -> None:
         """THE hot-path record — one per dispatched job.  Pre-formatted
         ``%``-string, no dict or dumps (see ``run_journal_gate``)."""
-        self._append('{"t":"d","j":"%s"}' % job_id, "d")
+        self._append('{"t":"d","j":%s}' % _jid(job_id), "d")
 
     def record_submit(self, job_id: str, sid: str, gk: Optional[str],
                       payload: Dict[str, Any]) -> None:
@@ -398,15 +424,16 @@ class DispatchJournal:
 
     def record_complete(self, job_id: str, fitness: float,
                         parked: bool = False) -> None:
-        self._append('{"t":"c","j":"%s","f":%r,"pk":%d}'
-                     % (job_id, float(fitness), 1 if parked else 0), "c")
+        self._append('{"t":"c","j":%s,"f":%s,"pk":%d}'
+                     % (_jid(job_id), _jfloat(float(fitness)),
+                        1 if parked else 0), "c")
 
     def record_fail(self, job_id: str, reason: str) -> None:
         self._append(json.dumps({"t": "x", "j": job_id, "r": reason},
                                 separators=(",", ":")), "x")
 
     def record_requeue(self, job_id: str) -> None:
-        self._append('{"t":"q","j":"%s"}' % job_id, "q")
+        self._append('{"t":"q","j":%s}' % _jid(job_id), "q")
 
     def record_cancel(self, job_ids: List[str]) -> None:
         self._append(json.dumps({"t": "cx", "js": list(job_ids)},
@@ -420,10 +447,10 @@ class DispatchJournal:
              "r": remote}, separators=(",", ":")), "so")
 
     def record_session_close(self, sid: str) -> None:
-        self._append('{"t":"sc","sid":"%s"}' % sid, "sc")
+        self._append('{"t":"sc","sid":%s}' % _jid(sid), "sc")
 
     def record_flush(self, sid: str) -> None:
-        self._append('{"t":"fl","sid":"%s"}' % sid, "fl")
+        self._append('{"t":"fl","sid":%s}' % _jid(sid), "fl")
 
     def record_quarantine(self, sid: str, gk: str) -> None:
         self._append(json.dumps({"t": "g", "sid": sid, "gk": gk},

@@ -8,6 +8,7 @@ state, losing nothing and double-counting nothing.
 """
 
 import json
+import math
 import os
 import socket
 import threading
@@ -219,6 +220,58 @@ class TestJournalReplay:
             "type": "results", "session": "t1",
             "results": [{"job_id": "j1", "fitness": 3.5}],
         }]
+
+    def test_nonfinite_fitness_round_trips(self, tmp_path):
+        # json.dumps emits NaN on the wire and _on_result's float()
+        # accepts it, so the journal must survive a non-finite fitness:
+        # a bare %r 'nan' would be unparseable on replay and brick the
+        # restart.  Journaled as a quoted string, restored to float.
+        p = str(tmp_path / "nan.journal")
+        jrn = DispatchJournal(p)
+        jrn.open()
+        jrn.record_session_open("t1", 1.0, None, True)
+        cases = (("j1", float("nan")), ("j2", float("inf")),
+                 ("j3", float("-inf")), ("j4", 2.5))
+        for j, f in cases:
+            jrn.record_submit(j, "t1", None, {"genes": {"a": [1]}})
+            jrn.record_complete(j, f, parked=True)
+        jrn.flush()
+        jrn.compact()  # the snapshot path must round-trip them too
+        jrn.close()
+        state = replay_file(p)
+        assert not state.torn_tail and state.jobs == {}
+        got = [fr["results"][0]["fitness"]
+               for fr in state.sessions["t1"]["parked"]]
+        assert math.isnan(got[0])
+        assert got[1:] == [float("inf"), float("-inf"), 2.5]
+
+    def test_hostile_ids_cannot_tear_or_forge_records(self, tmp_path):
+        # job/session ids are caller- and wire-provided arbitrary
+        # strings; a quote, backslash, or newline must neither produce a
+        # malformed line (JournalCorruptError on restart) nor inject a
+        # forged record.
+        p = str(tmp_path / "hostile.journal")
+        sid = 'ten"ant\\\n{"t":"sc","sid":"x"}'
+        jid = 'job"\\one\ntwo'
+        jrn = DispatchJournal(p)
+        jrn.open()
+        jrn.record_session_open(sid, 1.0, None, True)
+        jrn.record_submit(jid, sid, "g1", {"genes": {"a": [1]}})
+        jrn.record_dispatch(jid)
+        jrn.record_requeue(jid)
+        jrn.record_flush(sid)
+        jrn.record_session_open('clo"se', 1.0, None, True)
+        jrn.record_session_close('clo"se')
+        jrn.close()
+        state = replay_file(p)
+        assert not state.torn_tail
+        # The d/q records found their sub (ids agree across encodings):
+        assert set(state.jobs) == {jid}
+        assert state.jobs[jid]["d"] is False
+        assert not state.sessions[sid]["closed"]
+        assert state.sessions['clo"se']["closed"]
+        # The sc embedded in the hostile sid never applied:
+        assert "x" not in state.sessions
 
     def test_double_requeue_is_idempotent(self, tmp_path):
         state = ReplayState()
@@ -440,6 +493,26 @@ class TestAdmissionControl:
         finally:
             if client is not None:
                 client.close()
+            broker.stop()
+
+    def test_oversize_batch_admitted_as_debt(self):
+        # A submit with more jobs than the burst can never be satisfied
+        # by waiting, so retry_after_s must not promise otherwise: with a
+        # full bucket the batch is admitted and drives the bucket
+        # negative (debt-based bucket), throttling later requests while
+        # the debt refills.
+        broker = JobBroker(port=0, admission_rate=10.0,
+                           admission_burst=5.0).start()
+        try:
+            assert broker._admission_check("t-big", cost=20.0) is None
+            tokens, _ = broker._admission_buckets["t-big"]
+            assert tokens < 0  # the oversize cost was charged in full
+            verdict = broker._admission_check("t-big", cost=1.0)
+            assert verdict is not None
+            reason, retry = verdict
+            # The promised wait is honest: need ≤ burst always refills.
+            assert reason == "rate_limited" and 0 < retry <= 21.0 / 10.0
+        finally:
             broker.stop()
 
     def test_saturation_rejects_submit_asynchronously(self):
