@@ -45,7 +45,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Set
 
-from ..parallel.mesh import job_size_class
+from ..parallel.mesh import SIZE_SMALL, job_size_class
 from ..telemetry import health as _health
 from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
@@ -121,12 +121,12 @@ class _Worker:
 
     __slots__ = ("worker_id", "writer", "capacity", "prefetch_depth", "credit",
                  "in_flight", "last_seen", "n_chips", "backend", "draining",
-                 "mesh", "caps")
+                 "mesh", "caps", "preemptible")
 
     def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
                  n_chips: int = 1, backend: Optional[str] = None,
                  prefetch_depth: int = 0, mesh: Optional[Dict[str, int]] = None,
-                 caps: frozenset = frozenset()):
+                 caps: frozenset = frozenset(), preemptible: bool = False):
         self.worker_id = worker_id
         self.writer = writer
         self.capacity = capacity
@@ -148,6 +148,11 @@ class _Worker:
         #: intersection of what the worker advertised on ``hello`` and what
         #: this broker speaks.  Empty ⇔ the v1 frame set — every old worker.
         self.caps = caps
+        #: Preemptible-capacity advertisement (protocol.py "Preemptible-
+        #: capacity field"): True routes cheap rung-0 probes here when the
+        #: fleet is mixed; absent/malformed on the wire degrades to False
+        #: (stable), the conservative default.
+        self.preemptible = preemptible
         #: True once the worker announced an orderly exit (elastic
         #: membership): no new dispatches, excluded from the fleet sums —
         #: but still a live connection until its in-flight results land.
@@ -347,6 +352,9 @@ class JobBroker:
         self._encode_samples = 0
         self._workers: Dict[int, _Worker] = {}
         self._worker_seq = itertools.count()
+        # Sticky once any preemptible member has joined: gates the
+        # preemptible_members gauge so stable-only fleets emit no new series.
+        self._seen_preemptible = False
         # Telemetry (loop-thread only): monotonic (re)enqueue stamp per open
         # job, feeding queue_wait and job spans.  Populated only while
         # telemetry is enabled; pruned wherever _payloads is pruned.
@@ -1138,6 +1146,14 @@ class JobBroker:
         read — safe from any thread."""
         return len(self._workers)
 
+    def fleet_preemptible(self) -> int:
+        """Number of LIVE (non-draining) workers advertising preemptible
+        capacity.  The autoscaler's churn gauge and the placement plane's
+        existence check share this read.  Snapshot read — safe from any
+        thread."""
+        return sum(1 for w in list(self._workers.values())
+                   if w.preemptible and not w.draining)
+
     def fleet_mesh_pop(self) -> int:
         """Largest pop-axis size advertised by the LIVE fleet (1 when no
         worker advertised a mesh).
@@ -1284,6 +1300,35 @@ class JobBroker:
             sum(max(0, len(w.in_flight) - w.capacity)
                 for w in self._workers.values()))
 
+    def job_prefers_preemptible(self, job_id: str) -> bool:
+        """Placement class of one open job: True ⇔ preemptible-preferred.
+
+        Exactly the ASHA economics (DISTRIBUTED.md "Autoscaling &
+        preemptible capacity"): a rung-0 small-class probe is cheap and
+        fully requeue-able, so losing its worker mid-train costs one cheap
+        retrain — route it to capacity that may vanish.  A high-rung
+        promotion (rung ≥ 1) or a big/micro-class genome embodies real
+        chip-seconds (or an axis-split program that must not thrash), so
+        it pins to stable members.  Size class is judged worker-
+        independently (``n_devices=1``) — a placement class must not
+        change with whichever worker happens to be asking.  Pure dict
+        reads plus the memoized :func:`job_size_class`; the per-decision
+        cost is gated ≤ 2% of a dispatch by scripts/broker_throughput.py
+        ``run_placement_gate``.
+        """
+        pl = self._payloads.get(job_id)
+        if pl is None:  # defensive: racing a cancel — class is moot
+            return False
+        if (pl.get("fidelity") or {}).get("rung", 0):
+            return False
+        return job_size_class(pl.get("additional_parameters")) == SIZE_SMALL
+
+    def _placeable_for(self, worker_preemptible: bool):
+        """The ``pop_next`` placement filter for one worker's class."""
+        if worker_preemptible:
+            return self.job_prefers_preemptible
+        return lambda job_id: not self.job_prefers_preemptible(job_id)
+
     def _dispatch(self) -> None:
         """Hand pending jobs to workers with spare credit (competing consumers).
 
@@ -1296,6 +1341,12 @@ class JobBroker:
         round-robin across sessions, with per-session ``max_in_flight``
         quotas enforced here (a quota-full session's jobs stay queued and
         its turn passes to the others — work conservation).
+
+        In a mixed stable+preemptible fleet the pass is also placement-
+        aware: each worker only takes jobs of its class (rung-0 small
+        probes → preemptible, everything else → stable), and the pass
+        repeats while it makes progress so a head-of-queue job unblocked
+        mid-pass still reaches a worker visited earlier.
         """
         if self._sched.depth() == 0:
             return
@@ -1314,103 +1365,130 @@ class JobBroker:
             return quota is None or inflight.get(sid, 0) < quota
 
         exhausted = False  # no session has a dispatchable job left
-        for w in list(self._workers.values()):
-            if exhausted:
-                break
-            if w.draining:  # orderly exit in progress: never hand it work
-                continue
-            batch: List[tuple] = []  # (job_id, JobWire)
-            batch_bytes = 0
-            use_jobs2 = "jobs2" in w.caps
-            # Keep each frame well under the protocol cap: submit() bounds
-            # single jobs, but a large-capacity worker's combined batch could
-            # exceed it — flush into multiple `jobs` frames when needed (the
-            # client reads frames one per consume-loop iteration).
-            soft_cap = MAX_MESSAGE_BYTES // 2
-            while w.credit > 0:
-                nxt = self._sched.pop_next(
-                    eligible, lambda j: j in self._payloads)
-                if nxt is None:  # nothing queued, or every session quota-full
-                    exhausted = True
+        workers = list(self._workers.values())
+        # Placement-aware dispatch (protocol.py "Preemptible-capacity
+        # field") activates only for a MIXED live fleet: with both classes
+        # present, rung-0 small-class probes route to preemptible members
+        # and everything else pins to stable.  A homogeneous fleet takes
+        # every job wherever there is credit — the "fallback to any
+        # capacity when a class has none" rule, and what keeps the
+        # stable-only path byte-identical to the pre-placement broker.
+        placement_on = (
+            any(w.preemptible for w in workers if not w.draining)
+            and any(not w.preemptible for w in workers if not w.draining))
+        while True:
+            progress = False
+            for w in workers:
+                if exhausted:
                     break
-                sid, job_id = nxt
-                w.credit -= 1
-                w.in_flight.add(job_id)
-                inflight[sid] = inflight.get(sid, 0) + 1
-                if jrn is not None:
-                    # THE hot-path journal record: a pre-formatted string
-                    # append; fsync is the journal task's, never ours.
-                    jrn.record_dispatch(job_id)
-                # Size-class dispatch accounting (big-genome regime,
-                # docs/OBSERVABILITY.md): one labeled counter bump per
-                # handoff.  job_size_class is jax-free integer math on the
-                # payload config — its cost share of a dispatch is gated
-                # at <= 2% by scripts/broker_throughput.py.
-                _get_registry().counter(
-                    "jobs_dispatched_total",
-                    genome_size_class=job_size_class(
-                        self._payloads[job_id].get("additional_parameters"),
-                        int((w.mesh or {}).get("devices") or 1)),
-                ).inc()
-                if tele:
-                    # queue_wait: time from (re)enqueue to handoff.  The
-                    # stamp stays in place — _on_result uses it for the
-                    # end-to-end job span.
-                    attrs = {"worker": w.worker_id}
-                    if sid != DEFAULT_SESSION:
-                        attrs["session"] = sid
-                    t_enq = self._tele_enqueued.get(job_id)
-                    if t_enq is not None:
-                        wait = time.monotonic() - t_enq
-                        _tele.record_span(
-                            "queue_wait", t_enq, wait,
-                            trace=self._payloads[job_id].get("trace"),
-                            attrs=attrs,
-                        )
-                        # The registry twin of the span: a per-job wait
-                        # histogram dashboards can read without span
-                        # post-processing (tail-regime pressure signal).
-                        # Session-labeled only for tenant jobs, so the
-                        # single-tenant series name never changes.
+                if w.draining:  # orderly exit in progress: never hand it work
+                    continue
+                placeable = (self._placeable_for(w.preemptible)
+                             if placement_on else None)
+                batch: List[tuple] = []  # (job_id, JobWire)
+                batch_bytes = 0
+                use_jobs2 = "jobs2" in w.caps
+                # Keep each frame well under the protocol cap: submit() bounds
+                # single jobs, but a large-capacity worker's combined batch could
+                # exceed it — flush into multiple `jobs` frames when needed (the
+                # client reads frames one per consume-loop iteration).
+                soft_cap = MAX_MESSAGE_BYTES // 2
+                while w.credit > 0:
+                    nxt = self._sched.pop_next(
+                        eligible, lambda j: j in self._payloads, placeable)
+                    if nxt is None:
+                        # Nothing queued / every session quota-full — or,
+                        # with placement on, every queue head pinned to the
+                        # OTHER class.  Only the class-blind read proves the
+                        # whole pass is done.
+                        if placeable is None:
+                            exhausted = True
+                        break
+                    progress = True
+                    sid, job_id = nxt
+                    w.credit -= 1
+                    w.in_flight.add(job_id)
+                    inflight[sid] = inflight.get(sid, 0) + 1
+                    if jrn is not None:
+                        # THE hot-path journal record: a pre-formatted string
+                        # append; fsync is the journal task's, never ours.
+                        jrn.record_dispatch(job_id)
+                    # Size-class dispatch accounting (big-genome regime,
+                    # docs/OBSERVABILITY.md): one labeled counter bump per
+                    # handoff.  job_size_class is jax-free integer math on the
+                    # payload config — its cost share of a dispatch is gated
+                    # at <= 2% by scripts/broker_throughput.py.
+                    _get_registry().counter(
+                        "jobs_dispatched_total",
+                        genome_size_class=job_size_class(
+                            self._payloads[job_id].get("additional_parameters"),
+                            int((w.mesh or {}).get("devices") or 1)),
+                    ).inc()
+                    if tele:
+                        # queue_wait: time from (re)enqueue to handoff.  The
+                        # stamp stays in place — _on_result uses it for the
+                        # end-to-end job span.
+                        attrs = {"worker": w.worker_id}
                         if sid != DEFAULT_SESSION:
-                            _get_registry().histogram(
-                                "queue_wait_s", session=sid).observe(wait)
-                        else:
-                            _get_registry().histogram("queue_wait_s").observe(wait)
-                    # dispatch_rtt_s starts here: handoff to the worker.
-                    self._tele_dispatched[job_id] = time.monotonic()
-                if _lineage.enabled():
-                    pl = self._payloads[job_id]
-                    _lineage.record(
-                        "dispatched", self._job_genome.get(job_id),
-                        job=job_id, worker=w.worker_id,
-                        rung=(pl.get("fidelity") or {}).get("rung", 0),
-                        session=sid if sid != DEFAULT_SESSION else None)
-                if ops:
-                    # Same clock start as dispatch_rtt_s: the watchdog
-                    # measures handoff → now against its rolling threshold.
-                    self._watchdog.job_started(
-                        job_id, w.worker_id,
-                        session=sid if sid != DEFAULT_SESSION else None)
-                # Encode-once fast path: the entry bytes were assembled at
-                # enqueue (or on a previous dispatch of this very job) and
-                # size the split AND join the frame — a requeued job costs
-                # zero serialization here.
-                jw = self._job_wire.get(job_id)
-                if jw is None:  # defensive: open job without a record
-                    jw = build_job_wire(job_id, self._payloads[job_id],
-                                        self._job_genome.get(job_id)
-                                        or genome_key(self._payloads[job_id].get("genes")),
-                                        self._frag_cache)
-                    self._job_wire[job_id] = jw
-                entry_bytes = len(jw.v1)
-                if batch and batch_bytes + entry_bytes > soft_cap:
+                            attrs["session"] = sid
+                        t_enq = self._tele_enqueued.get(job_id)
+                        if t_enq is not None:
+                            wait = time.monotonic() - t_enq
+                            _tele.record_span(
+                                "queue_wait", t_enq, wait,
+                                trace=self._payloads[job_id].get("trace"),
+                                attrs=attrs,
+                            )
+                            # The registry twin of the span: a per-job wait
+                            # histogram dashboards can read without span
+                            # post-processing (tail-regime pressure signal).
+                            # Session-labeled only for tenant jobs, so the
+                            # single-tenant series name never changes.
+                            if sid != DEFAULT_SESSION:
+                                _get_registry().histogram(
+                                    "queue_wait_s", session=sid).observe(wait)
+                            else:
+                                _get_registry().histogram("queue_wait_s").observe(wait)
+                        # dispatch_rtt_s starts here: handoff to the worker.
+                        self._tele_dispatched[job_id] = time.monotonic()
+                    if _lineage.enabled():
+                        pl = self._payloads[job_id]
+                        _lineage.record(
+                            "dispatched", self._job_genome.get(job_id),
+                            job=job_id, worker=w.worker_id,
+                            rung=(pl.get("fidelity") or {}).get("rung", 0),
+                            session=sid if sid != DEFAULT_SESSION else None)
+                    if ops:
+                        # Same clock start as dispatch_rtt_s: the watchdog
+                        # measures handoff → now against its rolling threshold.
+                        self._watchdog.job_started(
+                            job_id, w.worker_id,
+                            session=sid if sid != DEFAULT_SESSION else None)
+                    # Encode-once fast path: the entry bytes were assembled at
+                    # enqueue (or on a previous dispatch of this very job) and
+                    # size the split AND join the frame — a requeued job costs
+                    # zero serialization here.
+                    jw = self._job_wire.get(job_id)
+                    if jw is None:  # defensive: open job without a record
+                        jw = build_job_wire(job_id, self._payloads[job_id],
+                                            self._job_genome.get(job_id)
+                                            or genome_key(self._payloads[job_id].get("genes")),
+                                            self._frag_cache)
+                        self._job_wire[job_id] = jw
+                    entry_bytes = len(jw.v1)
+                    if batch and batch_bytes + entry_bytes > soft_cap:
+                        self._flush_batch(w, batch, use_jobs2)
+                        batch, batch_bytes = [], 0
+                    batch.append((job_id, jw))
+                    batch_bytes += entry_bytes
+                if batch:
                     self._flush_batch(w, batch, use_jobs2)
-                    batch, batch_bytes = [], 0
-                batch.append((job_id, jw))
-                batch_bytes += entry_bytes
-            if batch:
-                self._flush_batch(w, batch, use_jobs2)
+            # One pass is the whole story for a class-blind fleet.  A mixed
+            # fleet repeats while the pass made progress: a preemptible pop
+            # can expose a stable-pinned job mid-pass (and vice versa) for a
+            # worker the iteration already visited.
+            if not placement_on or exhausted or not progress:
+                break
         if tele:
             self._update_flow_gauges()
 
@@ -1665,6 +1743,7 @@ class JobBroker:
             "n_chips": w.n_chips,
             "backend": w.backend,
             "draining": w.draining,
+            "preemptible": w.preemptible,
             "mesh": w.mesh,
             "wire_caps": sorted(w.caps),
         } for w in list(self._workers.values())]
@@ -1680,6 +1759,7 @@ class JobBroker:
             },
             "members": len(workers),
             "draining": sum(1 for x in workers if x["draining"]),
+            "preemptible_members": self.fleet_preemptible(),
             "live_capacity": self.fleet_capacity(),
             "live_prefetch": self.fleet_prefetch(),
             "queue_depth": self._sched.depth(),
@@ -1751,6 +1831,9 @@ class JobBroker:
                 # Grant only capabilities BOTH ends speak; an old worker
                 # advertises nothing and lands on the v1 frame set.
                 caps=parse_caps(hello) & self._wire_caps,
+                # Strict literal check — absent/malformed degrades to
+                # stable, the conservative placement default.
+                preemptible=hello.get("preemptible") is True,
             )
             # Heterogeneous-fleet check (ADVICE r3): two workers scoring one
             # generation with different estimators (e.g. xgb.cv on one host,
@@ -1769,6 +1852,12 @@ class JobBroker:
                 reg = _get_registry()
                 reg.gauge("broker_workers_connected").set(len(self._workers))
                 reg.gauge("fleet_members").set(len(self._workers))
+                # Gauge appears only once a preemptible member has EVER
+                # joined — a stable-only fleet's metric snapshot gains no
+                # new series (PR-2 off-path contract).
+                if worker.preemptible or self._seen_preemptible:
+                    self._seen_preemptible = True
+                    reg.gauge("preemptible_members").set(self.fleet_preemptible())
             _tele.record_event("worker_joined", {
                 "worker_id": worker.worker_id, "capacity": worker.capacity,
                 "prefetch_depth": worker.prefetch_depth,
@@ -1873,6 +1962,9 @@ class JobBroker:
                     reg = _get_registry()
                     reg.gauge("broker_workers_connected").set(len(self._workers))
                     reg.gauge("fleet_members").set(len(self._workers))
+                    if self._seen_preemptible:
+                        reg.gauge("preemptible_members").set(
+                            self.fleet_preemptible())
                 _tele.record_event("worker_left", {
                     "worker_id": worker.worker_id,
                     "drained": worker.draining,
@@ -2158,6 +2250,10 @@ class JobBroker:
         w.credit = 0
         tele = _tele.enabled()
         ops = _health.enabled()
+        # OPTIONAL drain attribution (protocol.py "Preemptible-capacity
+        # field"): "preempt" marks capacity-reclaim churn; anything else —
+        # absent, old worker, hostile — degrades to the plain "drain".
+        reason = "preempt" if msg.get("reason") == "preempt" else "drain"
         requeued = 0
         for job_id in msg.get("requeue") or ():
             job_id = str(job_id)
@@ -2174,7 +2270,7 @@ class JobBroker:
             if _lineage.enabled():
                 _lineage.record(
                     "requeued", self._job_genome.get(job_id),
-                    job=job_id, worker=w.worker_id, reason="drain",
+                    job=job_id, worker=w.worker_id, reason=reason,
                     session=sid if sid != DEFAULT_SESSION else None)
             if ops:
                 self._watchdog.job_removed(job_id)
@@ -2188,10 +2284,13 @@ class JobBroker:
         if tele:
             _get_registry().counter("worker_drains_total",
                                     worker=w.worker_id).inc()
+            if self._seen_preemptible:
+                _get_registry().gauge("preemptible_members").set(
+                    self.fleet_preemptible())
             self._update_flow_gauges()
         _tele.record_event("worker_draining", {
             "worker_id": w.worker_id, "requeued": requeued,
-            "finishing": len(w.in_flight),
+            "finishing": len(w.in_flight), "reason": reason,
         })
         self._dispatch()
 
@@ -2219,6 +2318,14 @@ class JobBroker:
             # Host-mesh workers re-advertise their shape with the new
             # capacity (elastic mesh shrink/grow: device lost or returned).
             w.mesh = self._parse_mesh(msg)
+        if "preemptible" in msg:
+            # Placement class change (e.g. a spot VM promoted to reserved
+            # capacity).  Strict literal check, like hello.
+            w.preemptible = msg["preemptible"] is True
+            if _tele.enabled() and (w.preemptible or self._seen_preemptible):
+                self._seen_preemptible = True
+                _get_registry().gauge("preemptible_members").set(
+                    self.fleet_preemptible())
         w.credit = min(w.credit, w.window)
         logger.info("worker %s re-advertised capacity=%d prefetch=%d%s",
                     w.worker_id, w.capacity, w.prefetch_depth,

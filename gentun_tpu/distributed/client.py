@@ -169,6 +169,7 @@ class GentunClient:
         aggregator_url: Optional[str] = None,
         fault_injector=None,
         wire_caps: Optional[tuple] = None,
+        preemptible: bool = False,
     ):
         self.species = species
         self.x_train = x_train
@@ -217,6 +218,14 @@ class GentunClient:
         self.reconnect_delay = float(reconnect_delay)
         self.reconnect_max_delay = float(reconnect_max_delay)
         self.worker_id = worker_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        # Preemptible capacity (protocol.py "Preemptible-capacity field"):
+        # advertised on hello/advertise so the broker's placement routes
+        # cheap rung-0 probes here and pins promotions to stable members.
+        # False is the wire default — a stable worker never sends the key.
+        self.preemptible = bool(preemptible)
+        # Drain attribution for the NEXT drain frame ("drain"|"preempt");
+        # "drain" is the wire default and is never sent explicitly.
+        self._drain_reason = "drain"
         self._injector = fault_injector
         # Wire fast path (protocol.py "Wire fast path"): capabilities this
         # worker ADVERTISES on hello; what the broker GRANTS comes back on
@@ -450,6 +459,11 @@ class GentunClient:
             # OPTIONAL advisory field (protocol.py "Host-mesh field"):
             # old brokers ignore unknown hello keys.
             hello["mesh"] = mesh
+        if self.preemptible:
+            # OPTIONAL placement hint (protocol.py "Preemptible-capacity
+            # field"): only ever sent as ``true`` — absent means stable,
+            # so a stable worker's hello is byte-identical to before.
+            hello["preemptible"] = True
         if self._wire_caps:
             # OPTIONAL capability advertisement (protocol.py "Wire fast
             # path"): old brokers ignore it and keep speaking v1 frames.
@@ -718,7 +732,7 @@ class GentunClient:
         """True once :meth:`drain` or :meth:`shutdown` has been requested."""
         return self._drain_req.is_set()
 
-    def drain(self) -> None:
+    def drain(self, reason: str = "drain") -> None:
         """Request an orderly exit (elastic membership; thread-safe).
 
         The consume loop notices at its next batch boundary: the window
@@ -728,7 +742,14 @@ class GentunClient:
         :meth:`work` returns.  A worker blocked waiting for its first jobs
         in the serial (``prefetch_depth=0``) flow only notices when a
         frame arrives — use :meth:`shutdown` for an immediate hard stop.
+
+        ``reason`` attributes the drain on the wire ("drain"|"preempt");
+        the broker stamps it on the requeue lineage events so preemption
+        churn is separable from operator drains.  Anything else degrades
+        to "drain" broker-side.
         """
+        if reason == "preempt":
+            self._drain_reason = "preempt"
         self._drain_req.set()
 
     def shutdown(self) -> None:
@@ -762,6 +783,8 @@ class GentunClient:
         mesh = self._mesh_advert()
         if mesh is not None:
             frame["mesh"] = mesh  # host-mesh shape rides along (OPTIONAL)
+        if self.preemptible:
+            frame["preemptible"] = True  # placement hint (OPTIONAL)
         try:
             self._send(frame)
         except OSError:
@@ -770,8 +793,14 @@ class GentunClient:
     def _announce_drain(self, unstarted_job_ids: List[str]) -> None:
         """Send the ``drain`` frame; never raises (broker death during a
         drain just means the disconnect requeue does the whole job)."""
+        frame: Dict[str, Any] = {"type": "drain",
+                                 "requeue": list(unstarted_job_ids)}
+        if self._drain_reason != "drain":
+            # OPTIONAL attribution — the default is never sent, so an
+            # operator drain's frame is byte-identical to before.
+            frame["reason"] = self._drain_reason
         try:
-            self._send({"type": "drain", "requeue": list(unstarted_job_ids)})
+            self._send(frame)
         except OSError:
             pass
         logger.info("worker %s draining: returned %d queued job(s)",

@@ -63,6 +63,23 @@ protocol error):
   granted by the worker's next ``ready``.  Ignored from a draining
   worker.
 
+Preemptible-capacity field (same OPTIONAL-with-conservative-default
+convention — placement hint, never load-bearing for correctness):
+
+- ``hello`` and ``advertise`` may carry ``preemptible`` (bool): the
+  worker runs on capacity that may be reclaimed (``gentun-worker
+  --preempt``; a spot/preemptible VM, or an autoscaler-managed member).
+  A broker that understands it routes cheap requeue-able work there
+  first — rung-0 probes — and pins high-rung promotions and big/micro
+  genomes to stable members when both classes exist, falling back to any
+  capacity when one class is absent (``broker._dispatch`` placement).
+  Anything but the JSON literal ``true`` — absent, old worker, malformed
+  — degrades to stable, the conservative default: a stable-only fleet
+  dispatches byte-identically to a broker that predates the field.
+  ``drain`` may carry ``reason`` ("preempt"): attribution for the
+  requeue lineage events so a study can separate preemption churn from
+  operator drains; unknown or absent reasons degrade to "drain".
+
 Host-mesh field (same OPTIONAL convention — pure observability, never
 load-bearing for correctness):
 

@@ -303,6 +303,7 @@ class FairShareScheduler:
         self,
         eligible: Callable[[str], bool],
         valid: Callable[[str], bool],
+        placeable: Optional[Callable[[str], bool]] = None,
     ) -> Optional[Tuple[str, str]]:
         """The next ``(session, job_id)`` to dispatch, or None when every
         backlogged session is ineligible (quota) or nothing is queued.
@@ -310,10 +311,23 @@ class FairShareScheduler:
         ``valid`` filters dead jobs (cancelled while queued): invalid ids
         are discarded WITHOUT charging the session's deficit — a cancelled
         job must not cost its tenant a dispatch turn.
+
+        ``placeable`` (optional) is the placement-aware dispatch filter
+        (broker ``_dispatch``): a job whose head-of-queue id fails it is
+        NOT popped — it stays queued, exactly where it was, and the
+        session sits this call out (no deficit charge, no rotation); the
+        pop moves on to other sessions.  Head-of-line, not scan-the-queue,
+        deliberately: intra-session dispatch order stays strictly FIFO,
+        which is what keeps requeue/dedup reasoning simple, and the cost
+        of a blocked head is bounded — the next mixed-fleet dispatch pass
+        offers the head to the other placement class.  ``placeable=None``
+        is byte-for-byte the pre-placement behavior.
         """
+        blocked: Set[str] = set()
         while True:
             candidates = [sid for sid in self._order
-                          if self._queues.get(sid) and eligible(sid)]
+                          if sid not in blocked
+                          and self._queues.get(sid) and eligible(sid)]
             if not candidates:
                 return None
             chosen = next((sid for sid in candidates
@@ -329,10 +343,20 @@ class FairShareScheduler:
                 continue
             q = self._queues[chosen]
             while q:
-                job_id = q.popleft()
-                self._session_of.pop(job_id, None)
+                # Peek-then-pop: a valid-but-unplaceable head must stay
+                # queued (it is NOT cancelled, just wrong for this worker),
+                # where invalid heads are popped and discarded exactly as
+                # before — peek+pop is equivalent to pop for those paths.
+                job_id = q[0]
                 if not valid(job_id):
+                    q.popleft()
+                    self._session_of.pop(job_id, None)
                     continue  # cancelled while queued: free, no deficit cost
+                if placeable is not None and not placeable(job_id):
+                    blocked.add(chosen)
+                    break  # head pinned elsewhere: session waits, queue intact
+                q.popleft()
+                self._session_of.pop(job_id, None)
                 self._deficit[chosen] -= 1.0
                 # Rotate the served session to the back so equal-weight
                 # tenants interleave instead of draining one at a time.
@@ -345,6 +369,8 @@ class FairShareScheduler:
                 else:
                     self._drop_session(chosen)
                 return chosen, job_id
+            if chosen in blocked:
+                continue
             # Queue emptied without a valid job: forfeit deficit, retry.
             self._drop_session(chosen)
 

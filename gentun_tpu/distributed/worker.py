@@ -190,6 +190,19 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-plan", default=None, metavar="PATH",
                     help="chaos testing: JSON FaultPlan (distributed/faults.py) "
                          "injected into this worker's client hooks")
+    ap.add_argument("--preempt", action="store_true",
+                    help="advertise this worker as PREEMPTIBLE capacity: the "
+                         "broker routes cheap rung-0 probes here and pins "
+                         "high-rung promotions to stable workers.  SIGUSR1 "
+                         "acts as the preemption deadline signal — the worker "
+                         "self-drains through the ordinary SIGTERM drain path "
+                         "with the requeue attributed to preemption.  See "
+                         "DISTRIBUTED.md 'Autoscaling & preemptible capacity'.")
+    ap.add_argument("--preempt-after", type=float, default=None,
+                    metavar="SECONDS",
+                    help="self-preempt after SECONDS (implies --preempt): a "
+                         "deterministic deadline for chaos studies, "
+                         "equivalent to receiving SIGUSR1 then")
     ap.add_argument("--wire-v1", action="store_true",
                     help="advertise NO wire capabilities: pin this worker to "
                          "the v1 frame set even against a jobs2-capable "
@@ -255,6 +268,11 @@ def main(argv=None) -> int:
             raise SystemExit(f"--mesh: {e}")
     if args.prefetch_depth is not None and args.prefetch_depth < 0:
         raise SystemExit(f"--prefetch-depth must be >= 0, got {args.prefetch_depth}")
+    if args.preempt_after is not None:
+        if args.preempt_after <= 0:
+            raise SystemExit(
+                f"--preempt-after must be > 0 seconds, got {args.preempt_after}")
+        args.preempt = True  # a deadline only makes sense on preemptible capacity
     if args.ops_port is not None and not 0 <= args.ops_port <= 65535:
         raise SystemExit(f"--ops-port must be in [0, 65535], got {args.ops_port}")
     if args.cache_url is not None:
@@ -353,6 +371,7 @@ def main(argv=None) -> int:
             aggregator_url=args.aggregator_url,
             fault_injector=injector,
             wire_caps=() if args.wire_v1 else None,
+            preemptible=args.preempt,
         )
     except ValueError as e:
         # Config errors the CLI could not pre-validate — notably a --mesh
@@ -380,11 +399,38 @@ def main(argv=None) -> int:
                 "requeueing the rest; signal again to stop now", signum)
             client.drain()
 
+    # Preemption deadline (DISTRIBUTED.md "Autoscaling & preemptible
+    # capacity"): SIGUSR1 — or the --preempt-after timer for deterministic
+    # studies — is "your capacity is being reclaimed".  It reuses the
+    # drain machinery above verbatim, differing only in the wire-level
+    # ``reason`` so the broker's requeue lineage attributes the churn to
+    # preemption; a second SIGUSR1 escalates to shutdown like SIGTERM.
+    def _on_preempt(signum=None, frame=None):
+        if client.draining:
+            client.shutdown()
+            return
+        logging.getLogger("gentun_tpu.distributed").warning(
+            "preemption deadline: self-draining (in-flight work finishes, "
+            "queued jobs requeue to the fleet)")
+        from ..telemetry.registry import get_registry
+
+        get_registry().counter("preemptions_total",
+                               worker=client.worker_id).inc()
+        client.drain(reason="preempt")
+
     try:
         signal.signal(signal.SIGTERM, _on_signal)
         signal.signal(signal.SIGINT, _on_signal)
+        if args.preempt:
+            signal.signal(signal.SIGUSR1, _on_preempt)
     except ValueError:  # pragma: no cover - non-main-thread embedding
         pass
+    if args.preempt_after is not None:
+        import threading
+
+        timer = threading.Timer(args.preempt_after, _on_preempt)
+        timer.daemon = True
+        timer.start()
     try:
         done = client.work(max_jobs=args.max_jobs)
     except AuthError as e:

@@ -702,6 +702,8 @@ class MetricsAggregator:
                     "subject": tr["subject"],
                     "value": tr["value"],
                     "threshold": tr["threshold"],
+                    "transition_seq": tr["transition_seq"],
+                    "firing_since": tr["firing_since"],
                     "t": tr["t"],
                 })
         return transitions

@@ -237,6 +237,15 @@ class _AlertState:
     fires: int = 0
     flaps: int = 0
     last_transition: float = 0.0
+    #: Engine-global monotonic counter stamped at every fire/clear edge.
+    #: A poller that caches the last seq it saw detects a fire→clear→fire
+    #: cycle even when both edges land between two polls — the seq moved
+    #: by 2, where every timestamp-based scheme races the poll interval.
+    transition_seq: int = 0
+    #: Wall time of the CURRENT firing episode (0.0 while not firing).
+    #: ``fired_at`` is "most recent fire ever" and survives the clear for
+    #: flap accounting; ``firing_since`` is the edge-triggered view.
+    firing_since: float = 0.0
 
     def public(self) -> Dict[str, Any]:
         return {
@@ -250,6 +259,8 @@ class _AlertState:
             "fired_at": self.fired_at,
             "fires": self.fires,
             "flaps": self.flaps,
+            "transition_seq": self.transition_seq,
+            "firing_since": self.firing_since,
             "description": self.rule.description,
         }
 
@@ -274,6 +285,10 @@ class SloEngine:
         self._alerts: Dict[Tuple[str, str], _AlertState] = {}
         self._history: List[Dict[str, Any]] = []
         self._max_history = 256
+        # Monotonic across ALL alerts in this engine — one counter, not
+        # per-rule, so a watcher can order interleaved transitions from
+        # different rules with a single cursor.
+        self._transition_seq = 0
 
     # -- measurement -------------------------------------------------------
 
@@ -347,6 +362,8 @@ class SloEngine:
     # -- lifecycle ---------------------------------------------------------
 
     def _transition(self, st: _AlertState, event: str, now: float) -> Dict[str, Any]:
+        self._transition_seq += 1
+        st.transition_seq = self._transition_seq
         rec = {"event": event, "t": now, **st.public()}
         self._history.append(rec)
         if len(self._history) > self._max_history:
@@ -377,6 +394,7 @@ class SloEngine:
                         if now - st.pending_since >= rule.for_s:
                             st.state = _FIRING
                             st.fired_at = now
+                            st.firing_since = now
                             st.fires += 1
                             transitions.append(self._transition(st, "fire", now))
                 elif st.state == _PENDING:
@@ -385,6 +403,7 @@ class SloEngine:
                     elif now - st.pending_since >= rule.for_s:
                         st.state = _FIRING
                         st.fired_at = now
+                        st.firing_since = now
                         st.fires += 1
                         transitions.append(self._transition(st, "fire", now))
                 elif st.state == _FIRING:
@@ -399,6 +418,7 @@ class SloEngine:
                         if now - st.fired_at <= 2 * rule.clear_for_s + rule.for_s:
                             st.flaps += 1
                         st.cleared_at = now
+                        st.firing_since = 0.0
                         transitions.append(self._transition(st, "clear", now))
         return transitions
 

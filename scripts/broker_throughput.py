@@ -697,6 +697,61 @@ def run_journal_gate(per_job_dispatch_us: float,
     }
 
 
+def run_placement_gate(per_job_dispatch_us: float) -> dict:
+    """Placement-aware dispatch cost in a mixed fleet, micro-timed.
+
+    With preemptible and stable members both live, every scheduler pop
+    filters candidates through ``job_prefers_preemptible``: two dict
+    lookups (the payload and its fidelity rung) plus a memoized
+    ``parallel.mesh.job_size_class`` call — and the dispatch loop builds
+    one ``_placeable_for`` closure per worker pass.  The steady-state
+    worst case per job is two classifications (the head peeked once by a
+    wrong-class worker, then popped by the right one), so the gate bills
+    both.  Same instrument as the forensics/compile/surrogate/sizeclass
+    gates: batched min-of-repeats with the size-class memo warm (every
+    genome classifies once, then dispatch/requeue/peek all hit the
+    cache), divided by the measured per-job dispatch cost."""
+    from gentun_tpu.utils import fidelity_fingerprint
+
+    broker = JobBroker(port=0)  # never started: _payloads + the check only
+    params = {"nodes": (4, 4)}
+    fp = fidelity_fingerprint(params)
+    n = 2000
+    for i in range(n):
+        broker._payloads[f"p{i}"] = {
+            "genes": {"S_1": [0, 1, 0, 1, 0, 1], "S_2": [1, 0, 1, 0, 1, 0]},
+            "additional_parameters": params,
+            "fidelity": {"v": 1, "rung": i % 3, "fingerprint": fp},
+        }
+    job_ids = [f"p{i}" for i in range(n)]
+    pre_filter = broker._placeable_for(True)
+    stable_filter = broker._placeable_for(False)
+    for jid in job_ids:
+        pre_filter(jid)  # warm the size-class memo (steady state)
+    assert pre_filter("p0") and stable_filter("p1"), \
+        "bench payloads must split across placement classes"
+
+    def _loop():
+        for jid in job_ids:
+            stable_filter(jid)  # wrong-class head peek
+            pre_filter(jid)     # right-class pop
+
+    reps, inner = 3, 10
+    t_pair_s = min(timeit.repeat(_loop, number=inner, repeat=reps)) / (
+        inner * n)
+    per_job_added_us = round(t_pair_s * 1e6, 3)
+    overhead_pct = round(per_job_added_us / per_job_dispatch_us * 100.0, 3)
+    return {
+        "checks_per_job": 2,
+        "check_us": round(t_pair_s / 2 * 1e6, 3),
+        "per_job_added_us": per_job_added_us,
+        "per_job_dispatch_us": per_job_dispatch_us,
+        "overhead_pct": overhead_pct,
+        "gate_max_pct": 2.0,
+        "within_gate": overhead_pct <= 2.0,
+    }
+
+
 def _print_hot_path_table(out: dict) -> None:
     """Consolidated per-job hot-path cost table → stderr (stdout is the
     JSON artifact).  One row per gated plane, so 'what does a dispatched
@@ -723,6 +778,8 @@ def _print_hot_path_table(out: dict) -> None:
          f"-{out['wire']['redispatch_reduction_pct']}%"),
         ("dispatch journal (on)", out["journal"]["per_job_added_us"],
          f"{out['journal']['overhead_pct']}% of dispatch"),
+        ("placement class check", out["placement"]["per_job_added_us"],
+         f"{out['placement']['overhead_pct']}% of dispatch"),
     ]
     w = max(len(r[0]) for r in rows)
     print(f"\nper-job hot-path cost ({out['n_workers']} workers, "
@@ -832,6 +889,18 @@ def main() -> dict:
         f"dispatch-journal overhead {out['journal']['overhead_pct']}% "
         f"exceeds the 2% gate ({out['journal']['per_job_added_us']}us added "
         f"on {out['journal']['per_job_dispatch_us']}us/job dispatch)")
+
+    # Placement gate (DISTRIBUTED.md "Autoscaling & preemptible
+    # capacity"): the per-pop placement-class check a mixed fleet adds to
+    # the dispatch hot path must also stay <=2% of per-job dispatch cost.
+    # Same denominator again.
+    out["placement"] = run_placement_gate(
+        out["forensics"]["per_job_dispatch_us"])
+    assert out["placement"]["within_gate"], (
+        f"placement class-check overhead "
+        f"{out['placement']['overhead_pct']}% exceeds the 2% gate "
+        f"({out['placement']['per_job_added_us']}us added on "
+        f"{out['placement']['per_job_dispatch_us']}us/job dispatch)")
 
     _print_hot_path_table(out)
 

@@ -1362,6 +1362,230 @@ def run_broker_kill() -> dict:
     }
 
 
+def run_preemption_act() -> dict:
+    """Preemption chaos act (DISTRIBUTED.md "Autoscaling & preemptible
+    capacity"): a mostly-preemptible fleet under the full storm — two
+    SIGUSR1-style self-drains mid-flight (the ``--preempt`` deadline
+    path, each followed by a replacement member joining), a broker
+    SIGKILL + journal restart, and a dropped ``results`` connection —
+    must finish bit-identical to the stable single-process reference.
+    Asserts the requeue storm completes (zero lost: every
+    preemption-requeued job re-dispatches and the broker ends
+    quiescent), that the churn is attributed in the lineage ledger
+    (``requeued`` events with reason ``preempt``, distinct from the
+    disconnect/drain reasons the other faults produce), and that the
+    idle stable member proves mixed-fleet placement holds under chaos
+    (rung-0 work stays on preemptible capacity throughout)."""
+    mutation_rate = 0.5  # novel genomes every generation: dispatch stays live
+
+    # Stable-fleet reference: single-process, telemetry-free, same seeds
+    # (SlowishOneMax == OneMax fitness values; the sleep only shapes
+    # timing in the distributed arm).
+    ref = GeneticAlgorithm(
+        Population(SlowishOneMax, *DATA, size=POP_SIZE, seed=POP_SEED,
+                   mutation_rate=mutation_rate), seed=GA_SEED)
+    ref.run(GENERATIONS)
+    ref_snap = _snapshot(ref)
+
+    script_dir = os.path.dirname(os.path.abspath(__file__))
+    tele_path = os.path.join(script_dir, ".chaos_preempt_telemetry.jsonl")
+    jpath = os.path.join(script_dir, ".chaos_preempt.journal")
+    for p in (jpath, jpath + ".snap"):
+        if os.path.exists(p):
+            os.unlink(p)
+    run_tele = RunTelemetry(tele_path, label="chaos-preempt").install()
+    lineage.reset_ledger()
+    lineage.enable()
+
+    drop_inj = FaultInjector(FaultPlan([
+        FaultSpec(hook="client_send", kind="drop_connection",
+                  match_type="results", at=0),
+    ], seed=2026))
+
+    port = _free_port()
+    broker = JobBroker(port=port, journal_path=jpath,
+                       journal_fsync_interval=0.01).start()
+    fleet: dict = {}
+
+    def _spawn_preemptible(wid, injector=None):
+        stop = threading.Event()
+        client = GentunClient(
+            SlowishOneMax, *DATA, host="127.0.0.1", port=port,
+            worker_id=wid, capacity=1, prefetch_depth=3,
+            heartbeat_interval=0.2, reconnect_delay=0.05,
+            reconnect_max_delay=0.5, fault_injector=injector,
+            preemptible=True)
+        threading.Thread(target=lambda: client.work(stop_event=stop),
+                         daemon=True).start()
+        fleet[wid] = (client, stop)
+
+    _spawn_preemptible("preempt-w0", injector=drop_inj)
+    _spawn_preemptible("preempt-w1")
+    stable_stop = _worker(port, worker_id="preempt-stable",
+                          species=SlowishOneMax)
+
+    done = threading.Event()
+    kill_info: dict = {}
+    preemptions: list = []
+    t0 = time.monotonic()
+    try:
+        pop = DistributedPopulation(
+            OneMax, size=POP_SIZE, seed=POP_SEED,
+            mutation_rate=mutation_rate, host="127.0.0.1", port=port,
+            broker=broker, job_timeout=120)
+        try:
+            ga = GeneticAlgorithm(pop, seed=GA_SEED)
+
+            def _completes():
+                jrn = broker._journal
+                return (jrn.status()["records_total"].get("c", 0)
+                        if jrn is not None else -1)
+
+            def _worker_loaded(wid, n, deadline_s=60.0):
+                # True once `wid` is CONNECTED (present, not draining —
+                # so the drain announce has a live socket to ride, not
+                # the injected drop's reconnect window) and holds >= n
+                # jobs (capacity 1: at least n-1 prefetched-unstarted,
+                # guaranteeing the drain has something to hand back).
+                deadline = time.monotonic() + deadline_s
+                while time.monotonic() < deadline and not done.is_set():
+                    ws = {x["worker_id"]: x
+                          for x in broker._ops_status()["workers"]}
+                    w = ws.get(wid)
+                    if (w is not None and not w["draining"]
+                            and w["jobs_in_flight"] >= n):
+                        return True
+                    time.sleep(0.005)
+                return False
+
+            def _storm():
+                # Two preemption waves first (each drains a member whose
+                # prefetch window is demonstrably loaded, then joins a
+                # replacement), then the broker SIGKILL + restart.
+                for wid in ("preempt-w0", "preempt-w1"):
+                    if not _worker_loaded(wid, 2):
+                        return
+                    client, stop = fleet.pop(wid)
+                    client.drain(reason="preempt")  # the SIGUSR1 path
+                    preemptions.append(
+                        {"worker": wid, "at_generation": len(ga.history)})
+                    time.sleep(0.5)  # in-flight job finishes, drain lands
+                    stop.set()
+                    _spawn_preemptible(wid + "-r")
+                deadline = time.monotonic() + 60
+                while (time.monotonic() < deadline and not done.is_set()
+                       and _completes() < 20):
+                    time.sleep(0.005)
+                kill_info["completes_at_kill"] = _completes()
+                t_kill = time.monotonic()
+                broker.kill()
+                broker.start()
+                kill_info["restart_wall_s"] = round(
+                    time.monotonic() - t_kill, 3)
+
+            storm = threading.Thread(target=_storm, daemon=True)
+            storm.start()
+            ga.run(GENERATIONS)
+            done.set()
+            storm.join(timeout=90)
+            wall = time.monotonic() - t0
+            chaos_snap = _snapshot(ga)
+            leaked = broker.outstanding()
+            ops = broker._ops_status()
+            # Bound the lineage record to the live search: teardown
+            # below churns the orphan resurrection job through whatever
+            # members are still exiting, which is shutdown noise, not
+            # placement evidence.
+            lineage.disable()
+        finally:
+            pop.close()
+    finally:
+        done.set()
+        for _, stop in fleet.values():
+            stop.set()
+        stable_stop.set()
+        run_tele.close()
+        lineage.disable()
+        lineage.reset_ledger()
+        broker.stop()
+        for p in (jpath, jpath + ".snap"):
+            if os.path.exists(p):
+                os.unlink(p)
+
+    assert len(preemptions) == 2, f"preemption waves misfired: {preemptions}"
+    assert "restart_wall_s" in kill_info, "broker kill never fired"
+    assert ops["epoch"] == 2 and ops["restarts"] == 1, ops
+    assert drop_inj.fired, "the drop_connection fault never fired"
+    identical = chaos_snap == ref_snap
+    assert identical, "preemption run diverged from the stable reference"
+    # The broker-kill composition adds run_broker_kill's documented
+    # at-least-once residue: a completion whose journal record died in
+    # the un-fsynced buffer resurrects at restart, re-runs, and its
+    # duplicate result has no gather left to claim it.  Orphan results
+    # are the ONLY tolerated leak; everything else must be quiescent.
+    non_result_leaks = {k: v for k, v in leaked.items() if k != "results"}
+    assert all(v == 0 for v in non_result_leaks.values()), (
+        f"leaked broker state: {leaked}")
+
+    with open(tele_path, encoding="utf-8") as fh:
+        tele_lines = [json.loads(line) for line in fh]
+    os.unlink(tele_path)
+    lin = [r for r in tele_lines if r.get("type") == "lineage"]
+    requeued_by_reason: dict = {}
+    for r in lin:
+        if r.get("event") == "requeued":
+            requeued_by_reason.setdefault(r.get("reason"), []).append(r)
+    preempt_requeued = requeued_by_reason.get("preempt", [])
+    assert preempt_requeued, (
+        f"preemption churn never attributed in lineage: "
+        f"{ {k: len(v) for k, v in requeued_by_reason.items()} }")
+    assert all(r["worker"] in ("preempt-w0", "preempt-w1")
+               for r in preempt_requeued), preempt_requeued
+    # Zero lost: every preemption-requeued job re-dispatched afterwards.
+    dispatches: dict = {}
+    for r in lin:
+        if r.get("event") == "dispatched":
+            dispatches[r["job"]] = dispatches.get(r["job"], 0) + 1
+    assert all(dispatches.get(r["job"], 0) >= 2 for r in preempt_requeued), (
+        "a preemption-requeued job never re-dispatched")
+    # Placement held under chaos: rung-0 work stays >=90% on preemptible
+    # capacity.  Not 100% — after the broker kill, whichever member
+    # reconnects first owns a briefly homogeneous fleet, and if that is
+    # the stable one, fallback (by design) hands it work rather than
+    # stalling the search until a preemptible member re-adopts.
+    all_dispatches = [r for r in lin if r.get("event") == "dispatched"]
+    stable_n = sum(1 for r in all_dispatches
+                   if r.get("worker") == "preempt-stable")
+    assert all_dispatches and stable_n * 10 <= len(all_dispatches), (
+        f"placement collapsed under chaos: {stable_n}/{len(all_dispatches)} "
+        f"rung-0 dispatches landed on the stable member")
+
+    return {
+        "generations": GENERATIONS,
+        "population_size": POP_SIZE,
+        "seeds": {"population": POP_SEED, "ga": GA_SEED},
+        "mutation_rate": mutation_rate,
+        "workers": {"preemptible": 2, "stable": 1, "replacements": 2},
+        "preemptions": preemptions,
+        "broker_kill": kill_info,
+        "epoch_after_restart": ops["epoch"],
+        "restarts": ops["restarts"],
+        "fault_plan": drop_inj.plan.to_dict(),
+        "faults_fired": list(drop_inj.fired),
+        "requeued_by_reason": {str(k): len(v)
+                               for k, v in sorted(requeued_by_reason.items())},
+        "preempt_requeued_jobs": sorted({r["job"] for r in preempt_requeued}),
+        "bit_identical_to_stable_reference": identical,
+        "dispatches": {"total": len(all_dispatches),
+                       "stable_member": stable_n,
+                       "preemptible_share_pct": round(
+                           (1 - stable_n / len(all_dispatches)) * 100, 1)},
+        "orphan_results_tolerated": leaked["results"],
+        "broker_state_after_final_gather": leaked,
+        "wall_s": round(wall, 3),
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
@@ -1374,6 +1598,7 @@ if __name__ == "__main__":
     out["wire"] = run_wire_act()
     out["obs_agg"] = run_obs_agg()
     out["broker_kill"] = run_broker_kill()
+    out["preemption"] = run_preemption_act()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

@@ -61,6 +61,7 @@ _HEADLINE_COUNTERS = (
     "session_rejected_total",
     "session_quarantined_total",
     "eval_pad_waste_total",
+    "preemptions_total",
 )
 
 
@@ -213,8 +214,11 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
         membership = ""
         if members is not None:
             draining = fleet.get("draining", 0)
+            preemptible = fleet.get("preemptible_members", 0)
             membership = (f"members {members}"
                           + (f" ({Y}{draining} draining{X})" if draining else "")
+                          + (f" ({preemptible} preemptible)" if preemptible
+                             else "")
                           + f"  window {fleet.get('live_capacity', '-')}"
                           f"+{fleet.get('live_prefetch', '-')}  ")
         lines.append(
@@ -238,6 +242,7 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                     f"{_fmt_age(w.get('last_seen_age_s')):>8}  "
                     f"{w.get('backend') or '-'}"
                     + (f"  {Y}v1-wire{X}" if w.get("wire_caps") == [] else "")
+                    + (f"  {D}PRE{X}" if w.get("preemptible") else "")
                     + (f"  {Y}DRAINING{X}" if w.get("draining") else ""))
         for s in fleet.get("stragglers", []):
             lines.append(f"  {Y}~ straggler {s['job_id']} on {s['worker_id']} "
@@ -403,6 +408,25 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                     + (f"  {D}(+{len(cells) - 4} more){X}"
                        if len(cells) > 4 else ""))
 
+    # Autoscaler / placement panel (DISTRIBUTED.md "Autoscaling &
+    # preemptible capacity"): target vs actual fleet size, decisions by
+    # direction and triggering rule, and reclaim volume.  Series exist
+    # only where the daemon's registry is scraped (in-process daemon, or
+    # a fleet view through the aggregator) — absent ⇔ no autoscaler.
+    if "autoscaler_decisions_total" in totals or "fleet_target_size" in totals:
+        by_action = _parse_labeled(metrics_text or "",
+                                   "autoscaler_decisions_total", "action")
+        by_rule = _parse_labeled(metrics_text or "",
+                                 "autoscaler_decisions_total", "rule")
+        rules = "  ".join(f"{r}={v:g}" for r, v in
+                          sorted(by_rule.items(), key=lambda kv: -kv[1]))
+        lines.append(
+            f"{B}autoscaler{X}  target {totals.get('fleet_target_size', '-'):g}"
+            f"  up {by_action.get('up', 0):g}  down {by_action.get('down', 0):g}"
+            + (f"  {D}{rules}{X}" if rules else "")
+            + (f"  preemptions {totals['preemptions_total']:g}"
+               if totals.get("preemptions_total") else ""))
+
     headline = [(n, totals[n]) for n in _HEADLINE_COUNTERS if n in totals]
     if headline:
         lines.append(f"{B}counters{X}  " + "  ".join(
@@ -535,7 +559,9 @@ def render_fleet(base: str, statusz, alertz, ringz, metrics_text,
             f"{n.replace('_total', '')}={v:g}" for n, v in headline))
     gauges = fleet.get("gauges") or {}
     interesting = [(n, v) for n, v in sorted(gauges.items())
-                   if n.startswith(("engine_", "session_queue_depth"))]
+                   if n.startswith(("engine_", "session_queue_depth",
+                                    "fleet_target_size",
+                                    "preemptible_members"))]
     if interesting:
         lines.append(f"{B}fleet gauges{X}  " + "  ".join(
             f"{n}={v:g}" for n, v in interesting))

@@ -1,0 +1,699 @@
+"""SLO-driven autoscaler daemon + preemption-tolerant placement.
+
+Covers the closed control loop the autoscaler PR builds: the ``/alertz``
+edge-trigger fields (``transition_seq``/``firing_since`` — a poller must
+see a fire→clear→fire cycle that lands entirely between two polls), the
+scheduler/broker placement plane (rung-0 probes to preemptible members,
+promotions pinned to stable, homogeneous fallback, off-path identity),
+the ``preemptible`` wire field's conservative degradation, the
+autoscaler-style drain race (prefetched-unstarted jobs all handed back,
+zero lost), the :class:`LocalProcessBackend` process pool, and the
+daemon's decision logic (hysteresis borrowed from the SLO machine,
+cooldown, clamps, edge detection, decision records).
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gentun_tpu import Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import GentunClient, JobBroker
+from gentun_tpu.distributed.autoscaler import (
+    AutoscalerDaemon,
+    FleetBackend,
+    LocalProcessBackend,
+)
+from gentun_tpu.distributed.sessions import FairShareScheduler
+from gentun_tpu.telemetry import lineage
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.aggregator import MetricsAggregator
+from gentun_tpu.telemetry.registry import get_registry
+from gentun_tpu.telemetry.slo import SeriesPoints, SloEngine, SloRule
+from gentun_tpu.utils import fidelity_fingerprint
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+class SlowOneMax(OneMax):
+    def evaluate(self):
+        time.sleep(0.5)
+        return super().evaluate()
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    lineage.disable()
+    lineage.reset_ledger()
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    lineage.disable()
+    lineage.reset_ledger()
+    get_registry().reset()
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def record(self, rec):
+        self.records.append(rec)
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _spawn_worker(species, port, worker_id, capacity=1, prefetch_depth=None,
+                  preemptible=False):
+    stop = threading.Event()
+    client = GentunClient(
+        species, *DATA, host="127.0.0.1", port=port, capacity=capacity,
+        prefetch_depth=prefetch_depth, worker_id=worker_id,
+        heartbeat_interval=0.2, reconnect_delay=0.05, preemptible=preemptible,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return client, stop, t
+
+
+# ---------------------------------------------------------------------------
+# /alertz edge triggering: transition_seq + firing_since (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _mk_view(points_by_name):
+    def view(pattern, **_):
+        from gentun_tpu.telemetry.slo import match_series
+        return [SeriesPoints(name, {"instance": "w0", "role": "worker"}, pts)
+                for name, pts in points_by_name.items()
+                if match_series(pattern, name)]
+    return view
+
+
+def _growing(now):
+    return {"errors_total": [(now - 30, 0.0), (now, 3.0)]}
+
+
+def _flat(now):
+    return {"errors_total": [(now - 5, 3.0), (now, 3.0)]}
+
+
+class TestAlertEdgeFields:
+    RULE = SloRule(name="r", kind="increase", series="errors_total",
+                   threshold=0.0, op=">", window_s=60.0, for_s=0.0,
+                   clear_for_s=10.0, subject="fleet")
+
+    def _alert(self, eng):
+        return eng.snapshot()["alerts"][0]
+
+    def test_polling_observes_fire_clear_fire_cycle(self):
+        """A watcher that only polls ``snapshot()`` between transitions
+        must still see every edge: the monotonic seq moves on each one,
+        so fire→clear→fire reads as seq+2 even if both edges landed
+        inside one poll gap."""
+        eng = SloEngine([self.RULE])
+        t0 = 1000.0
+        assert eng.evaluate(_mk_view(_growing(t0)), now=t0)
+        first = self._alert(eng)
+        assert first["state"] == "firing"
+        assert first["transition_seq"] == 1
+        assert first["firing_since"] == t0
+        # clear (healthy past clear_for_s) ...
+        eng.evaluate(_mk_view(_flat(t0 + 50)), now=t0 + 50)
+        eng.evaluate(_mk_view(_flat(t0 + 65)), now=t0 + 65)
+        cleared = self._alert(eng)
+        assert cleared["state"] == "inactive"
+        assert cleared["transition_seq"] == 2
+        assert cleared["firing_since"] == 0.0
+        # ... and re-fire: a FRESH edge with a fresh seq and timestamp.
+        assert eng.evaluate(_mk_view(_growing(t0 + 100)), now=t0 + 100)
+        second = self._alert(eng)
+        assert second["state"] == "firing"
+        assert second["transition_seq"] == 3
+        assert second["firing_since"] == t0 + 100
+        # The cursor contract: seq strictly increased across the cycle.
+        assert (first["transition_seq"] < cleared["transition_seq"]
+                < second["transition_seq"])
+
+    def test_seq_is_engine_global_across_rules(self):
+        other = SloRule(name="r2", kind="increase", series="boom_total",
+                        threshold=0.0, op=">", window_s=60.0, for_s=0.0,
+                        clear_for_s=10.0, subject="fleet")
+        eng = SloEngine([self.RULE, other])
+        t0 = 1000.0
+        view = _mk_view({**_growing(t0),
+                         "boom_total": [(t0 - 30, 0.0), (t0, 1.0)]})
+        fired = eng.evaluate(view, now=t0)
+        assert sorted(t["transition_seq"] for t in fired) == [1, 2]
+
+    def test_transition_records_carry_edge_fields(self):
+        eng = SloEngine([self.RULE])
+        t0 = 1000.0
+        (rec,) = eng.evaluate(_mk_view(_growing(t0)), now=t0)
+        assert rec["transition_seq"] == 1 and rec["firing_since"] == t0
+        assert eng.snapshot()["history"][-1]["transition_seq"] == 1
+
+    def test_aggregator_alert_record_carries_edge_fields(self):
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        rule = SloRule(name="deg", kind="increase", series="*_degraded_total",
+                       threshold=0.0, op=">", window_s=60.0, for_s=0.0,
+                       clear_for_s=3600.0, subject="instance")
+        agg = MetricsAggregator("127.0.0.1", 0, slo_rules=[rule])
+        for seq, v in ((1, 0.0), (2, 1.0)):
+            ok, detail = agg.push({
+                "instance": "w0", "role": "worker", "boot_id": "b", "seq": seq,
+                "metrics": {"counters": [{
+                    "name": "fitness_service_degraded_total",
+                    "labels": {}, "value": v}], "gauges": [], "histograms": []},
+            })
+            assert ok, detail
+            time.sleep(0.05)
+        assert agg.evaluate_slos()
+        recs = [r for r in sink.records if r.get("type") == "alert"]
+        assert recs and recs[0]["transition_seq"] == 1
+        assert recs[0]["firing_since"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Scheduler placement filter
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerPlacement:
+    @staticmethod
+    def _sched():
+        return FairShareScheduler(lambda sid: 1.0)
+
+    def test_unplaceable_head_stays_queued_and_turn_passes(self):
+        sched = self._sched()
+        sched.push("a", "a0")
+        sched.push("b", "b0")
+        # a0 is pinned elsewhere: session a sits out, b serves — and a's
+        # queue is untouched for the next (other-class) pass.
+        got = sched.pop_next(lambda s: True, lambda j: True,
+                             placeable=lambda j: j != "a0")
+        assert got == ("b", "b0")
+        assert sched.session_depth("a") == 1
+        assert sched.pop_next(lambda s: True, lambda j: True,
+                              placeable=lambda j: True) == ("a", "a0")
+
+    def test_all_heads_blocked_returns_none_queue_intact(self):
+        sched = self._sched()
+        sched.push("a", "a0")
+        sched.push("a", "a1")
+        assert sched.pop_next(lambda s: True, lambda j: True,
+                              placeable=lambda j: False) is None
+        assert sched.depth() == 2
+        # Intra-session FIFO preserved after the blocked pass.
+        assert sched.pop_next(lambda s: True, lambda j: True) == ("a", "a0")
+        assert sched.pop_next(lambda s: True, lambda j: True) == ("a", "a1")
+
+    def test_invalid_head_still_discarded_under_placement(self):
+        sched = self._sched()
+        sched.push("a", "dead")
+        sched.push("a", "live")
+        assert sched.pop_next(lambda s: True, lambda j: j != "dead",
+                              placeable=lambda j: True) == ("a", "live")
+        assert sched.depth() == 0
+
+    def test_blocked_session_charged_no_deficit(self):
+        sched = self._sched()
+        sched.push("a", "a0")
+        sched.pop_next(lambda s: True, lambda j: True,
+                       placeable=lambda j: False)
+        # The blocked pass must not have consumed a's dispatch turn: with
+        # a fresh competitor, a still wins its fair share immediately.
+        sched.push("b", "b0")
+        got = {sched.pop_next(lambda s: True, lambda j: True)
+               for _ in range(2)}
+        assert got == {("a", "a0"), ("b", "b0")}
+
+
+# ---------------------------------------------------------------------------
+# Preemptible wire field + placement-aware dispatch
+# ---------------------------------------------------------------------------
+
+
+def _tagged_jobs(prefix, genomes, rung):
+    params = {"kfold": 2}
+    fp = fidelity_fingerprint(params)
+    return {
+        f"{prefix}{i}": {
+            "genes": g, "additional_parameters": params,
+            "fidelity": {"v": 1, "rung": rung, "fingerprint": fp},
+        } for i, g in enumerate(genomes)
+    }
+
+
+class TestPreemptibleWire:
+    def test_hello_flag_lands_in_fleet_state(self):
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(OneMax, port, "pw-0", preemptible=True)
+            c1, s1, _ = _spawn_worker(OneMax, port, "pw-1")
+            assert _wait(lambda: broker.fleet_members() == 2)
+            assert broker.fleet_preemptible() == 1
+            ops = broker._ops_status()
+            assert ops["preemptible_members"] == 1
+            by_id = {w["worker_id"]: w for w in ops["workers"]}
+            assert by_id["pw-0"]["preemptible"] is True
+            # Back-compat: a worker that never sent the field is stable.
+            assert by_id["pw-1"]["preemptible"] is False
+            s0.set(), s1.set()
+        finally:
+            broker.stop()
+
+    def test_advertise_updates_placement_class(self):
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(OneMax, port, "adv-0")
+            assert _wait(lambda: broker.fleet_members() == 1)
+            assert broker.fleet_preemptible() == 0
+            c0.preemptible = True  # spot VM demoted mid-run
+            c0.advertise()
+            assert _wait(lambda: broker.fleet_preemptible() == 1)
+            s0.set()
+        finally:
+            broker.stop()
+
+    def test_drain_reason_preempt_attributed_in_lineage(self):
+        """A --preempt self-drain's requeued jobs must be attributable:
+        the lineage ledger separates preemption churn from operator
+        drains."""
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        lineage.enable()
+        genomes = [ind.get_genes() for ind in
+                   Population(OneMax, DATA, size=4, seed=3, maximize=True)]
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(SlowOneMax, port, "pre-0", capacity=1,
+                                      prefetch_depth=3, preemptible=True)
+            assert _wait(lambda: broker.fleet_members() == 1)
+            broker.submit({f"j{i}": {"genes": g}
+                           for i, g in enumerate(genomes)})
+            assert _wait(
+                lambda: broker._ops_status()["jobs_in_flight"] == 4)
+            c0.drain(reason="preempt")  # the SIGUSR1 deadline path
+            reqs = lambda: [r for r in sink.records
+                            if r.get("type") == "lineage"
+                            and r.get("event") == "requeued"]
+            assert _wait(lambda: len(reqs()) == 3, timeout=15)
+            assert all(r["reason"] == "preempt" for r in reqs())
+            s0.set()
+            c1, s1, _ = _spawn_worker(OneMax, port, "pre-1")
+            results = broker.gather([f"j{i}" for i in range(4)], timeout=30)
+            assert len(results) == 4
+            assert all(v == 0 for v in broker.outstanding().values())
+            s1.set()
+        finally:
+            broker.stop()
+
+
+class TestPlacementDispatch:
+    def test_mixed_fleet_routes_rungs_by_class(self):
+        """The acceptance routing: in a mixed fleet, EVERY rung-0 probe
+        dispatches to the preemptible member and EVERY rung-1 promotion
+        pins to stable — verified from lineage attribution alone."""
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        lineage.enable()
+        genomes = [ind.get_genes() for ind in
+                   Population(OneMax, DATA, size=8, seed=5, maximize=True)]
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(OneMax, port, "place-pre", capacity=1,
+                                      prefetch_depth=2, preemptible=True)
+            c1, s1, _ = _spawn_worker(OneMax, port, "place-stable", capacity=1,
+                                      prefetch_depth=2)
+            assert _wait(lambda: broker.fleet_members() == 2)
+            jobs = {**_tagged_jobs("probe", genomes[:4], rung=0),
+                    **_tagged_jobs("promo", genomes[4:], rung=1)}
+            broker.submit(jobs)
+            results = broker.gather(list(jobs), timeout=30)
+            assert len(results) == 8
+            dispatched = [r for r in sink.records
+                          if r.get("type") == "lineage"
+                          and r.get("event") == "dispatched"]
+            by_job = {r["job"]: r for r in dispatched}
+            assert len(by_job) == 8
+            for jid, rec in by_job.items():
+                if jid.startswith("probe"):
+                    assert rec["worker"] == "place-pre", (jid, rec)
+                    assert rec["rung"] == 0
+                else:
+                    assert rec["worker"] == "place-stable", (jid, rec)
+                    assert rec["rung"] == 1
+            assert all(v == 0 for v in broker.outstanding().values())
+            s0.set(), s1.set()
+        finally:
+            broker.stop()
+
+    def test_homogeneous_preemptible_fleet_takes_all_classes(self):
+        """Fallback: when a class has no capacity, placement disengages —
+        a preemptible-only fleet still evaluates rung-1 promotions."""
+        genomes = [ind.get_genes() for ind in
+                   Population(OneMax, DATA, size=4, seed=9, maximize=True)]
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(OneMax, port, "homo-0",
+                                      preemptible=True)
+            assert _wait(lambda: broker.fleet_members() == 1)
+            jobs = _tagged_jobs("promo", genomes, rung=1)
+            broker.submit(jobs)
+            results = broker.gather(list(jobs), timeout=30)
+            assert len(results) == 4
+            assert all(v == 0 for v in broker.outstanding().values())
+            s0.set()
+        finally:
+            broker.stop()
+
+    def test_stable_only_dispatch_bit_identical_to_pre_placement(self):
+        """PR-2 off-path contract: with no preemptible member, placement
+        never engages — dispatch order (lineage-attributed) is exactly
+        the scheduler's FIFO, as before the placement plane existed."""
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        lineage.enable()
+        genomes = [ind.get_genes() for ind in
+                   Population(OneMax, DATA, size=4, seed=2, maximize=True)]
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(OneMax, port, "off-0", capacity=1,
+                                      prefetch_depth=0)
+            assert _wait(lambda: broker.fleet_members() == 1)
+            jobs = {**_tagged_jobs("p", genomes[:2], rung=0),
+                    **_tagged_jobs("q", genomes[2:], rung=1)}
+            broker.submit(jobs)
+            results = broker.gather(list(jobs), timeout=30)
+            assert len(results) == 4
+            order = [r["job"] for r in sink.records
+                     if r.get("type") == "lineage"
+                     and r.get("event") == "dispatched"]
+            assert order == list(jobs), order  # submit order == FIFO
+            s0.set()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drain race (satellite 2): autoscaler-style drain with prefetched jobs
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscalerDrainRace:
+    def test_drain_hands_back_every_prefetched_unstarted_job(self):
+        """The exact race a scale-down decision creates: SIGTERM lands
+        while the worker's local prefetch queue holds unstarted jobs.
+        Every one must come back through ``drain {requeue: [...]}`` —
+        zero lost, broker quiescent after a replacement finishes."""
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        lineage.enable()
+        genomes = [ind.get_genes() for ind in
+                   Population(OneMax, DATA, size=5, seed=17, maximize=True)]
+        expected = {f"d{i}": float(sum(sum(g) for g in genomes[i].values()))
+                    for i in range(5)}
+        broker = JobBroker(port=0).start()
+        try:
+            _, port = broker.address
+            c0, s0, _ = _spawn_worker(SlowOneMax, port, "race-0", capacity=1,
+                                      prefetch_depth=4)
+            assert _wait(lambda: broker.fleet_members() == 1)
+            broker.submit({f"d{i}": {"genes": genomes[i]} for i in range(5)})
+            # The full window (1 training + 4 prefetched-unstarted) is out.
+            assert _wait(lambda: broker._ops_status()["jobs_in_flight"] == 5)
+            c0.drain()  # what LocalProcessBackend's SIGTERM triggers
+            reqs = lambda: [r for r in sink.records
+                            if r.get("type") == "lineage"
+                            and r.get("event") == "requeued"
+                            and r.get("reason") == "drain"]
+            # All 4 unstarted jobs hand back via the drain frame — not the
+            # disconnect sweep, which would tag them the same but race the
+            # worker's exit.
+            assert _wait(lambda: len(reqs()) == 4, timeout=15)
+            assert {r["job"] for r in reqs()} == {f"d{i}" for i in range(1, 5)}
+            # Zero lost: a replacement drains the conserved backlog dry.
+            s0.set()
+            c1, s1, _ = _spawn_worker(OneMax, port, "race-1", capacity=1,
+                                      prefetch_depth=4)
+            results = broker.gather(list(expected), timeout=30)
+            assert results == expected
+            assert all(v == 0 for v in broker.outstanding().values()), \
+                broker.outstanding()
+            s1.set()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# LocalProcessBackend
+# ---------------------------------------------------------------------------
+
+
+_SLEEPER = ("import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+            "time.sleep(600)\n")
+
+
+class TestLocalProcessBackend:
+    def test_spawn_drain_reap_cycle(self):
+        be = LocalProcessBackend([sys.executable, "-c", _SLEEPER])
+        try:
+            assert be.size() == 0
+            assert be.spawn(2) == 2
+            assert be.size() == 2
+            assert be.drain(1) == 1  # SIGTERM, the worker drain signal
+            assert _wait(lambda: (be.reap(), be.size() == 1)[1], timeout=10)
+            assert be.drain(5) == 1  # clamped to the living members
+            assert _wait(lambda: (be.reap(), be.size() == 0)[1], timeout=10)
+            desc = be.describe()
+            assert desc["spawned_total"] == 2 and desc["reaped_total"] == 2
+        finally:
+            be.drain(be.size())
+
+    def test_empty_argv_refused(self):
+        with pytest.raises(ValueError):
+            LocalProcessBackend([])
+
+
+# ---------------------------------------------------------------------------
+# AutoscalerDaemon decisions
+# ---------------------------------------------------------------------------
+
+
+class _FakeAgg:
+    """Duck-typed alert source: exactly the two reads the daemon does."""
+
+    def __init__(self):
+        self.alerts = []
+        self.rules = [{"name": "queue_depth_growth",
+                       "series": "session_queue_depth"},
+                      {"name": "worker_idle_ratio",
+                       "series": "worker_idle_s_sum"}]
+
+    def alertz(self):
+        return {"active": [a for a in self.alerts
+                           if a["state"] == "firing"],
+                "alerts": list(self.alerts), "history": [],
+                "rules": self.rules}
+
+    def ringz(self, name="*", instance=None):
+        return {"series": [{"name": name, "labels": {},
+                            "points": [[1.0, 2.0], [2.0, 9.0]]}],
+                "ring_len": 128}
+
+    def fire(self, rule, seq, subject="fleet", value=12.0):
+        self.alerts = [a for a in self.alerts if a["rule"] != rule]
+        self.alerts.append({
+            "rule": rule, "subject": subject, "state": "firing",
+            "value": value, "threshold": 8.0, "severity": "page",
+            "transition_seq": seq, "firing_since": 100.0 + seq,
+        })
+
+    def clear(self, rule):
+        self.alerts = [a for a in self.alerts if a["rule"] != rule]
+
+
+class _FakeBackend(FleetBackend):
+    def __init__(self, size=1):
+        self._size = size
+        self.spawned = 0
+        self.drained = 0
+
+    def size(self):
+        return self._size
+
+    def spawn(self, n):
+        self._size += n
+        self.spawned += n
+        return n
+
+    def drain(self, n):
+        self._size -= n
+        self.drained += n
+        return n
+
+    def reap(self):
+        return 0
+
+
+def _daemon(be, agg, **kw):
+    kw.setdefault("serve_http", False)
+    kw.setdefault("cooldown_s", 0.0)
+    return AutoscalerDaemon(be, aggregator=agg, **kw)
+
+
+class TestAutoscalerDecisions:
+    def test_scale_up_on_firing_saturation_alert(self):
+        sink = _ListSink()
+        spans_mod.enable()
+        spans_mod.set_run_sink(sink)
+        be, agg = _FakeBackend(size=1), _FakeAgg()
+        d = _daemon(be, agg, max_fleet=4)
+        assert d.decide_once(now=1000.0) is None  # healthy: no decision
+        agg.fire("queue_depth_growth", seq=1)
+        rec = d.decide_once(now=1001.0)
+        assert rec is not None and rec["action"] == "up"
+        assert be.spawned == 1 and be.size() == 2
+        assert rec["rule"] == "queue_depth_growth"
+        assert rec["transition_seq"] == 1
+        assert rec["from"] == 1 and rec["to"] == 2
+        assert rec["outcome"] == "spawned 1"
+        assert rec["evidence"]  # ring tail attached
+        # The record reached the telemetry sink and the decision ring.
+        assert [r for r in sink.records if r.get("type") == "scale"]
+        assert d.decisionz()["decisions"][-1] == rec
+        # Metrics: counter + target gauge.
+        snap = get_registry().snapshot()
+        ups = [c for c in snap["counters"]
+               if c["name"] == "autoscaler_decisions_total"]
+        assert ups and ups[0]["labels"]["action"] == "up"
+        assert get_registry().gauge("fleet_target_size").value == 2
+
+    def test_cooldown_suppresses_consecutive_decisions(self):
+        be, agg = _FakeBackend(size=1), _FakeAgg()
+        d = _daemon(be, agg, max_fleet=8, cooldown_s=30.0)
+        agg.fire("queue_depth_growth", seq=1)
+        assert d.decide_once(now=1000.0) is not None
+        agg.fire("queue_depth_growth", seq=2)  # even a fresh edge waits
+        assert d.decide_once(now=1010.0) is None
+        assert d.decide_once(now=1031.0) is not None  # cooldown elapsed
+        assert be.spawned == 2
+
+    def test_edge_only_mode_acts_once_per_transition(self):
+        be, agg = _FakeBackend(size=1), _FakeAgg()
+        d = _daemon(be, agg, max_fleet=8, repeat_while_firing=False)
+        agg.fire("queue_depth_growth", seq=1)
+        assert d.decide_once(now=1000.0) is not None
+        # Still firing, same seq: no repeat even with cooldown over.
+        assert d.decide_once(now=2000.0) is None
+        # A fire→clear→fire cycle BETWEEN polls: seq jumped — a fresh
+        # edge the poller never directly observed, still acted on.
+        agg.fire("queue_depth_growth", seq=3)
+        assert d.decide_once(now=3000.0) is not None
+        assert be.spawned == 2
+
+    def test_repeat_while_firing_steps_every_cooldown(self):
+        be, agg = _FakeBackend(size=1), _FakeAgg()
+        d = _daemon(be, agg, max_fleet=8, cooldown_s=10.0)
+        agg.fire("queue_depth_growth", seq=1)
+        for i, now in enumerate((1000.0, 1011.0, 1022.0)):
+            assert d.decide_once(now=now) is not None, i
+        assert be.size() == 4
+
+    def test_max_fleet_clamp_is_not_a_decision(self):
+        be, agg = _FakeBackend(size=3), _FakeAgg()
+        d = _daemon(be, agg, max_fleet=3)
+        agg.fire("queue_depth_growth", seq=1)
+        assert d.decide_once(now=1000.0) is None
+        assert be.spawned == 0 and d.decisionz()["total"] == 0
+
+    def test_scale_down_on_idle_clamped_at_min(self):
+        be, agg = _FakeBackend(size=3), _FakeAgg()
+        d = _daemon(be, agg, min_fleet=2, max_fleet=8)
+        agg.fire("worker_idle_ratio", seq=1, subject="w0", value=0.9)
+        rec = d.decide_once(now=1000.0)
+        assert rec is not None and rec["action"] == "down"
+        assert be.drained == 1 and be.size() == 2
+        # At min-fleet the next idle alert is a no-op, not a decision.
+        agg.fire("worker_idle_ratio", seq=2, subject="w0", value=0.9)
+        assert d.decide_once(now=2000.0) is None
+
+    def test_saturation_beats_idleness(self):
+        be, agg = _FakeBackend(size=2), _FakeAgg()
+        d = _daemon(be, agg, max_fleet=8)
+        agg.fire("queue_depth_growth", seq=1)
+        agg.fire("worker_idle_ratio", seq=2, subject="w0")
+        rec = d.decide_once(now=1000.0)
+        assert rec["action"] == "up" and be.size() == 3
+
+    def test_http_plane_serves_status_and_decisions(self):
+        be, agg = _FakeBackend(size=1), _FakeAgg()
+        d = AutoscalerDaemon(be, aggregator=agg, port=0, cooldown_s=0.0,
+                             max_fleet=4, poll_interval=30.0)
+        with d:
+            agg.fire("queue_depth_growth", seq=1)
+            assert d.decide_once(now=1000.0) is not None
+
+            def get(path):
+                with urllib.request.urlopen(d.url + path, timeout=5) as r:
+                    return json.loads(r.read().decode())
+
+            assert get("/healthz")["status"] == "ok"
+            status = get("/statusz")
+            assert status["config"]["max_fleet"] == 4
+            assert status["backend"]["size"] == 2
+            assert status["last_decision"]["action"] == "up"
+            dz = get("/decisionz")
+            assert dz["total"] == 1 and dz["decisions"][0]["rule"] == \
+                "queue_depth_growth"
+
+    def test_config_validation(self):
+        be, agg = _FakeBackend(), _FakeAgg()
+        with pytest.raises(ValueError):
+            AutoscalerDaemon(be)  # no source
+        with pytest.raises(ValueError):
+            AutoscalerDaemon(be, aggregator=agg,
+                             aggregator_url="http://x:1")  # two sources
+        with pytest.raises(ValueError):
+            _daemon(be, agg, min_fleet=5, max_fleet=2)
+        with pytest.raises(ValueError):
+            _daemon(be, agg, step=0)
