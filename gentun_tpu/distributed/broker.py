@@ -121,12 +121,13 @@ class _Worker:
 
     __slots__ = ("worker_id", "writer", "capacity", "prefetch_depth", "credit",
                  "in_flight", "last_seen", "n_chips", "backend", "draining",
-                 "mesh", "caps", "preemptible")
+                 "mesh", "caps", "preemptible", "homes")
 
     def __init__(self, worker_id: str, writer: asyncio.StreamWriter, capacity: int,
                  n_chips: int = 1, backend: Optional[str] = None,
                  prefetch_depth: int = 0, mesh: Optional[Dict[str, int]] = None,
-                 caps: frozenset = frozenset(), preemptible: bool = False):
+                 caps: frozenset = frozenset(), preemptible: bool = False,
+                 homes: int = 1):
         self.worker_id = worker_id
         self.writer = writer
         self.capacity = capacity
@@ -153,6 +154,14 @@ class _Worker:
         #: fleet is mixed; absent/malformed on the wire degrades to False
         #: (stable), the conservative default.
         self.preemptible = preemptible
+        #: Multi-home advertisement (protocol.py "Multi-home field"): how
+        #: many broker shards this worker connected to.  Informational —
+        #: this broker already advertised the worker's FULL window through
+        #: the normal credit path (the worker meters per-broker credit
+        #: itself) — but operators need it to read per-shard /statusz
+        #: capacity sums correctly: a 2-homed capacity-8 worker shows 8 on
+        #: BOTH shards.  1 for every single-homed (old) worker.
+        self.homes = homes
         #: True once the worker announced an orderly exit (elastic
         #: membership): no new dispatches, excluded from the fleet sums —
         #: but still a live connection until its in-flight results land.
@@ -1242,6 +1251,18 @@ class JobBroker:
         return max(0, min(depth, 4 * capacity))
 
     @staticmethod
+    def _parse_homes(hello: Dict[str, Any]) -> int:
+        """The worker's OPTIONAL multi-home advertisement (protocol.py
+        "Multi-home field"): how many broker shards it joined.  Missing
+        (every single-homed worker — the field is only sent when >1) or
+        malformed degrades to 1, never a dropped connection."""
+        try:
+            homes = int(hello.get("homes", 1))
+        except (TypeError, ValueError):
+            return 1
+        return max(1, homes)
+
+    @staticmethod
     def _parse_mesh(msg: Dict[str, Any]) -> Optional[Dict[str, int]]:
         """The worker's OPTIONAL host-mesh advertisement, validated.
 
@@ -1746,6 +1767,7 @@ class JobBroker:
             "preemptible": w.preemptible,
             "mesh": w.mesh,
             "wire_caps": sorted(w.caps),
+            "homes": w.homes,
         } for w in list(self._workers.values())]
         return {
             "address": list(self._bound) if self._started.is_set() else None,
@@ -1834,6 +1856,7 @@ class JobBroker:
                 # Strict literal check — absent/malformed degrades to
                 # stable, the conservative placement default.
                 preemptible=hello.get("preemptible") is True,
+                homes=self._parse_homes(hello),
             )
             # Heterogeneous-fleet check (ADVICE r3): two workers scoring one
             # generation with different estimators (e.g. xgb.cv on one host,
@@ -1858,6 +1881,11 @@ class JobBroker:
                 if worker.preemptible or self._seen_preemptible:
                     self._seen_preemptible = True
                     reg.gauge("preemptible_members").set(self.fleet_preemptible())
+                # Series appears only for multi-homed workers (ISSUE 18) —
+                # a single-broker fleet's metric snapshot gains nothing.
+                if worker.homes > 1:
+                    reg.gauge("worker_homes",
+                              worker=worker.worker_id).set(worker.homes)
             _tele.record_event("worker_joined", {
                 "worker_id": worker.worker_id, "capacity": worker.capacity,
                 "prefetch_depth": worker.prefetch_depth,
@@ -2086,10 +2114,37 @@ class JobBroker:
                     for job in msg.get("jobs") or ():
                         job = dict(job)
                         job_id = str(job.pop("job_id", "") or self.new_job_id())
+                        # Resubmit dedup (ISSUE 18): a sharded master whose
+                        # submit ack died with the link retries the SAME ids
+                        # after reconnect — ids still open here were already
+                        # enqueued, so scheduling them again would double-run
+                        # the job.  (Ids already TERMINAL re-run instead; the
+                        # client results table dedups by id, so at-least-once
+                        # still converges.)
+                        if job_id in self._payloads:
+                            continue
                         payloads[job_id] = job
-                    self._enqueue_jobs(payloads, sid)
+                    if payloads:
+                        self._enqueue_jobs(payloads, sid)
                 elif mtype == "cancel":
                     self._cancel_ids({str(j) for j in msg.get("jobs") or ()})
+                elif mtype == "session_stats":
+                    # Sizing snapshot for WIRE tenants (ISSUE 18): sharded
+                    # masters read their session's capacity/prefetch share
+                    # and the fleet's mesh/chip facts over the wire instead
+                    # of an embedded broker reference.  OPTIONAL message —
+                    # old clients never send it, old brokers never see it.
+                    sid = str(msg.get("session") or DEFAULT_SESSION)
+                    if msg.get("reset_chips") is True:
+                        self.reset_chips_seen()
+                    writer.write(encode({
+                        "type": "session_stats",
+                        "session": sid,
+                        "capacity": self.session_capacity(sid),
+                        "prefetch": self.session_prefetch(sid),
+                        "mesh_pop": self.fleet_mesh_pop(),
+                        "chips": self.chips_seen(),
+                    }))
                 elif mtype == "ping":
                     pass
                 else:

@@ -95,6 +95,21 @@ load-bearing for correctness):
   worker that never sends the field behaves — and is dispatched to —
   exactly as before.
 
+Multi-home field (same OPTIONAL convention — pure observability, never
+load-bearing for correctness):
+
+- ``hello`` may carry ``homes`` (int): how many broker SHARDS this
+  worker multi-homed to (horizontal sharding, ISSUE 18 — DISTRIBUTED.md
+  "Horizontal broker sharding").  Only sent when > 1, so a single-homed
+  worker's hello stays byte-identical.  The broker records it per worker
+  (``/statusz`` fleet table, ``worker_homes{worker}`` gauge) so
+  operators reading per-shard capacity sums know a 2-homed capacity-8
+  worker legitimately shows 8 on BOTH shards.  Credit stays per
+  connection exactly as before — each shard grants against the window
+  the worker advertised to IT, and the worker replenishes each batch's
+  credit at the shard that dispatched it.  Absent or malformed degrades
+  to 1, never a dropped connection.
+
 Multi-fidelity field (same OPTIONAL-with-conservative-default convention):
 
 - each ``jobs`` entry may carry ``fidelity`` {v, rung, fingerprint}: the
@@ -134,6 +149,20 @@ workers and old single-tenant masters interoperate unchanged):
     ``results`` frames carrying ``session``, terminal failures as ``fail``
     frames carrying ``session``.
   - ``cancel`` {jobs: [job_id, ...]}: withdraw still-open jobs.
+  - ``session_stats`` {session?, reset_chips?} → ``session_stats``
+    {session, capacity, prefetch, mesh_pop, chips}: the session's
+    weighted fleet share and the fleet-wide sizing facts
+    (``fleet_mesh_pop``, ``chips_seen``) — the wire mirror of the
+    in-process sizing reads, added for sharded masters (ISSUE 18) whose
+    engines run against remote brokers only.  ``reset_chips: true``
+    starts a fresh chips-seen observation window first.  Old clients
+    never send it; old brokers log-and-ignore it.
+
+- a wire ``submit`` whose ``job_id`` is ALREADY OPEN on this broker is
+  skipped silently (ISSUE 18): a sharded master whose submit ack died
+  with the link retries the same ids after reconnect, and re-enqueueing
+  them would double-run the jobs.  Ids already terminal DO re-run
+  (at-least-once); the client-side results table dedups by id.
 
 - a ``submit`` naming an UNKNOWN or CLOSED session is answered with a
   structured ``error`` {code: "session", session, reason} frame — loudly,

@@ -1,0 +1,518 @@
+"""Horizontal broker sharding: ring placement, multi-homed workers,
+sharded masters (DISTRIBUTED.md "Horizontal broker sharding").
+
+Covers the consistent-hash ring's contracts (deterministic cross-process
+placement, vnode balance, minimal movement on membership change), the
+per-connection reconnect backoff regression (a flapping shard must
+inflate only its OWN delay), multi-homed credit conservation across two
+live shards with a mid-run drain, the ``SessionClient`` router mode, and
+the end-to-end equality proof: a 2-shard ``DistributedPopulation`` GA
+run lands bit-identical to the single-broker reference.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import GeneticAlgorithm, Individual, genetic_cnn_genome
+from gentun_tpu.distributed import DistributedPopulation, GentunClient, JobBroker
+from gentun_tpu.distributed.sessions import SessionClient
+from gentun_tpu.distributed.shard import (
+    ShardedBroker,
+    ShardRing,
+    ShardRouter,
+    parse_broker_urls,
+    shard_id,
+)
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+
+
+class OneMax(Individual):
+    """Pure function of genes: sharded and single-broker evaluation agree
+    bit-for-bit, so the equality proofs below are exact."""
+
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+class CountingOneMax(OneMax):
+    """Slow enough that a drain lands mid-run, and every evaluate() call
+    is tallied — the exactly-once ledger for the credit-conservation
+    test."""
+
+    calls = []
+    _lock = threading.Lock()
+
+    def evaluate(self):
+        time.sleep(0.1)
+        with CountingOneMax._lock:
+            CountingOneMax.calls.append(1)
+        return super().evaluate()
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    get_registry().reset()
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _spawn_multihome_worker(species, urls, worker_id, capacity=1,
+                            prefetch_depth=None):
+    stop = threading.Event()
+    client = GentunClient(
+        species, *DATA, broker_urls=list(urls), capacity=capacity,
+        prefetch_depth=prefetch_depth, worker_id=worker_id,
+        heartbeat_interval=0.2, reconnect_delay=0.05,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return client, stop, t
+
+
+def _free_dead_port():
+    """A port nothing listens on: bind, read it off, close — connects to
+    it fail fast with ECONNREFUSED."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _sessions_on_distinct_shards(urls):
+    """Two session ids the ring homes on DIFFERENT shards of ``urls``."""
+    ring = ShardRing([shard_id(a) for a in parse_broker_urls(urls)])
+    by_shard = {}
+    for i in range(10_000):
+        sid = f"sess-{i:05d}"
+        by_shard.setdefault(ring.home(sid), sid)
+        if len(by_shard) == 2:
+            break
+    assert len(by_shard) == 2, "ring never split 10k keys across 2 shards"
+    return [by_shard[s] for s in sorted(by_shard)]
+
+
+class TestParseBrokerUrls:
+    def test_formats(self):
+        assert parse_broker_urls(["h1:7777", "tcp://h2:8888", ("h3", 9999)]) \
+            == [("h1", 7777), ("h2", 8888), ("h3", 9999)]
+
+    def test_duplicates_rejected(self):
+        with pytest.raises(ValueError):
+            parse_broker_urls(["h:1", "tcp://h:1"])
+
+    def test_garbage_rejected(self):
+        for bad in (["h"], ["h:notaport"], ["h:0"], [":7"], []):
+            with pytest.raises(ValueError):
+                parse_broker_urls(bad)
+
+    def test_order_preserved(self):
+        # Order is part of the ring identity only insofar as every party
+        # must parse the SAME list; the ring itself hashes shard ids.
+        assert parse_broker_urls(["b:2", "a:1"]) == [("b", 2), ("a", 1)]
+
+
+class TestShardRing:
+    SHARDS = ["10.0.0.1:7777", "10.0.0.2:7777", "10.0.0.3:7777"]
+
+    def test_placement_is_deterministic_cross_process(self):
+        # blake2b is keyless and unsalted: these exact placements must
+        # hold in EVERY process (masters and workers agree on homes
+        # without talking to each other).  Values pinned at ISSUE 18.
+        ring = ShardRing(self.SHARDS)
+        assert ring.home("s-alpha") == "10.0.0.1:7777"
+        assert ring.home("s-beta") == "10.0.0.3:7777"
+        assert ring.home("session-42") == "10.0.0.2:7777"
+
+    def test_shard_order_does_not_matter(self):
+        a = ShardRing(self.SHARDS)
+        b = ShardRing(list(reversed(self.SHARDS)))
+        keys = [f"s-{i:04d}" for i in range(200)]
+        assert [a.home(k) for k in keys] == [b.home(k) for k in keys]
+
+    def test_vnode_balance(self):
+        ring = ShardRing(self.SHARDS)
+        census = ring.census(f"s-{i:04d}" for i in range(999))
+        shares = [census.get(s, 0) / 999 for s in self.SHARDS]
+        # 64 vnodes/shard keeps the skew modest: no shard below 20% or
+        # above 45% of a 3-shard ring (measured 29–36%).
+        assert min(shares) > 0.20 and max(shares) < 0.45
+
+    def test_minimal_movement_on_remove_and_add(self):
+        ring = ShardRing(self.SHARDS)
+        keys = [f"s-{i:04d}" for i in range(500)]
+        before = {k: ring.home(k) for k in keys}
+        victim = self.SHARDS[1]
+        ring.remove(victim)
+        after = {k: ring.home(k) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # ONLY the removed shard's keys move (the consistent-hash
+        # guarantee) — and they all must, somewhere.
+        assert all(before[k] == victim for k in moved)
+        assert len(moved) == sum(1 for k in keys if before[k] == victim)
+        # Adding it back restores the original placement exactly.
+        ring.add(victim)
+        assert {k: ring.home(k) for k in keys} == before
+
+    def test_membership_errors(self):
+        ring = ShardRing(self.SHARDS)
+        with pytest.raises(ValueError):
+            ring.add(self.SHARDS[0])
+        with pytest.raises(ValueError):
+            ring.remove("10.9.9.9:1")
+        with pytest.raises(ValueError):
+            ShardRing([])
+
+    def test_successors_distinct_home_first(self):
+        ring = ShardRing(self.SHARDS)
+        succ = ring.successors("s-alpha")
+        assert succ[0] == ring.home("s-alpha")
+        assert sorted(succ) == sorted(self.SHARDS)
+
+
+class TestShardRouter:
+    def test_place_forget_and_gauges(self):
+        ring = ShardRing(["a:1", "b:2"])
+        router = ShardRouter(ring)
+        sids = [f"s-{i}" for i in range(40)]
+        for sid in sids:
+            assert router.place(sid) == ring.home(sid)
+        reg = get_registry()
+        total = sum(
+            reg.gauge("shard_sessions", shard=s).value for s in ring.shards)
+        assert total == len(sids)
+        for sid in sids:
+            router.forget(sid)
+        assert all(
+            reg.gauge("shard_sessions", shard=s).value == 0
+            for s in ring.shards)
+
+    def test_set_shards_counts_moves(self):
+        ring = ShardRing(["a:1", "b:2"])
+        router = ShardRouter(ring)
+        sids = [f"s-{i}" for i in range(60)]
+        for sid in sids:
+            router.place(sid)
+        before = dict(router.placements())
+        moved = router.set_shards(["a:1", "b:2", "c:3"])
+        after = router.placements()
+        assert moved == sum(1 for s in sids if before[s] != after[s])
+        assert get_registry().counter("shard_rebalances_total").value == moved
+        # Every moved session landed on the new shard — consistent
+        # hashing moves keys only TOWARD an added member.
+        assert all(after[s] == "c:3" for s in sids if before[s] != after[s])
+
+
+class TestPerConnectionBackoff:
+    def test_reconnect_backoff_is_per_connection(self):
+        # Satellite regression (ISSUE 18): a flapping shard inflates only
+        # its OWN redial delay.  One live broker + one dead port: the
+        # dead conn's backoff climbs while the live conn, having
+        # handshaken, stays reset at its base delay.
+        broker = JobBroker(host="127.0.0.1", port=0).start()
+        client = stop = None
+        try:
+            live = f"127.0.0.1:{broker.address[1]}"
+            dead = f"127.0.0.1:{_free_dead_port()}"
+            client, stop, _ = _spawn_multihome_worker(
+                OneMax, [live, dead], "bk-w0", capacity=1)
+            assert _wait(lambda: any(
+                c.handshaken for c in client._conns), timeout=10.0)
+            # Let the dead shard's manager burn a few redial cycles.
+            assert _wait(lambda: next(
+                c for c in client._conns if c.port != broker.address[1]
+            ).backoff._next > 3 * client.reconnect_delay, timeout=10.0)
+            live_conn = next(c for c in client._conns
+                             if c.port == broker.address[1])
+            assert live_conn.backoff._next == client.reconnect_delay
+            assert live_conn.handshaken and not live_conn.dead
+        finally:
+            if stop is not None:
+                stop.set()
+            if client is not None:
+                client.shutdown()
+            broker.stop()
+
+    def test_backoff_seed_is_per_endpoint(self):
+        # Decorrelated jitter must not march in lockstep across shards:
+        # distinct endpoint seeds give distinct delay sequences.
+        from gentun_tpu.distributed.client import _ReconnectBackoff
+
+        a = _ReconnectBackoff(0.05, 5.0, "w0:h1:1")
+        b = _ReconnectBackoff(0.05, 5.0, "w0:h2:2")
+        c = _ReconnectBackoff(0.05, 5.0, "w0:h1:1")
+        seq_a = [a.next_delay() for _ in range(6)]
+        seq_b = [b.next_delay() for _ in range(6)]
+        seq_c = [c.next_delay() for _ in range(6)]
+        assert seq_a == seq_c  # deterministic per seed
+        assert seq_a != seq_b  # decorrelated across endpoints
+
+    def test_multihome_rejects_multihost_and_injector(self):
+        with pytest.raises(ValueError):
+            GentunClient(OneMax, *DATA, broker_urls=["a:1", "b:2"],
+                         multihost=True)
+
+
+class TestMultihomeCreditConservation:
+    def test_concurrent_sessions_two_shards_with_drain(self):
+        # The satellite's core scenario: one worker homed on BOTH shards,
+        # two concurrent searches whose sessions the ring homes on
+        # different shards, a drain + replacement mid-run.  Proofs:
+        # every job evaluated exactly once, both searches land
+        # bit-identical to local evaluation, and each shard's credit
+        # books balance afterwards (advertised window fully returned).
+        b1 = JobBroker(host="127.0.0.1", port=0).start()
+        b2 = JobBroker(host="127.0.0.1", port=0).start()
+        urls = [f"127.0.0.1:{b.address[1]}" for b in (b1, b2)]
+        sid_a, sid_b = _sessions_on_distinct_shards(urls)
+        CountingOneMax.calls = []
+        pops = errs = None
+        w1 = s1 = w2 = s2 = None
+        try:
+            w1, s1, _ = _spawn_multihome_worker(
+                CountingOneMax, urls, "mh-w1", capacity=1, prefetch_depth=2)
+            pops = [
+                DistributedPopulation(
+                    CountingOneMax, size=6, seed=seed, maximize=True,
+                    broker_urls=urls, session=sid, job_timeout=60,
+                    evaluate_retries=2)
+                for seed, sid in ((11, sid_a), (22, sid_b))
+            ]
+            errs = []
+
+            def run_search(pop):
+                try:
+                    pop.evaluate()
+                except BaseException as e:  # surfaced below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=run_search, args=(p,))
+                       for p in pops]
+            for t in threads:
+                t.start()
+            # Drain the only worker once evaluation has started, then
+            # bring up a replacement — both searches must still finish.
+            assert _wait(lambda: len(CountingOneMax.calls) >= 2, timeout=30.0)
+            w1.drain()
+            w2, s2, _ = _spawn_multihome_worker(
+                CountingOneMax, urls, "mh-w2", capacity=1, prefetch_depth=2)
+            for t in threads:
+                t.join(timeout=90.0)
+            assert not any(t.is_alive() for t in threads)
+            assert errs == []
+            # Bit-identical to local evaluation (exactly-once landing of
+            # the RIGHT results — a cross-session mixup would break this).
+            for pop in pops:
+                for ind in pop.individuals:
+                    assert ind.get_fitness() == float(
+                        sum(sum(g) for g in ind.genes.values()))
+            # Exactly once: the drain finishes in-flight work and hands
+            # unstarted jobs back, so no evaluation is repeated.
+            assert len(CountingOneMax.calls) == sum(len(p.individuals)
+                                                    for p in pops)
+            # Credit conservation, per shard: with the fleet idle, every
+            # worker's outstanding credit equals its full advertised
+            # window on EACH shard it homes on, and nothing is in flight.
+            for broker in (b1, b2):
+                status = broker._ops_status()
+                assert status["open_jobs"] == 0
+                assert status["jobs_in_flight"] == 0
+                for w in status["workers"]:
+                    assert w["homes"] == 2
+                    assert w["credit"] == w["capacity"] + w["prefetch_depth"]
+        finally:
+            for pop in pops or ():
+                pop.close()
+            for stop_evt in (s1, s2):
+                if stop_evt is not None:
+                    stop_evt.set()
+            for client in (w1, w2):
+                if client is not None:
+                    client.shutdown()
+            b1.stop()
+            b2.stop()
+
+
+class TestSessionClientRouter:
+    def test_routed_submit_wait_stats(self):
+        b1 = JobBroker(host="127.0.0.1", port=0).start()
+        b2 = JobBroker(host="127.0.0.1", port=0).start()
+        urls = [f"127.0.0.1:{b.address[1]}" for b in (b1, b2)]
+        sid_a, sid_b = _sessions_on_distinct_shards(urls)
+        worker = stop = None
+        sc = None
+        try:
+            worker, stop, _ = _spawn_multihome_worker(
+                OneMax, urls, "rt-w0", capacity=2)
+            sc = SessionClient(broker_urls=urls)
+            for sid in (sid_a, sid_b):
+                sc.open_session(sid)
+            payload = {
+                "genes": {"S_1": [1, 1, 0, 1, 0, 1], "S_2": [1, 0, 1, 0, 1, 0]},
+                "additional_parameters": {"nodes": (4, 4)},
+            }
+            ids = (sc.submit(sid_a, {"ja-1": payload, "ja-2": payload})
+                   + sc.submit(sid_b, {"jb-1": payload}))
+            results = {}
+            deadline = time.monotonic() + 30.0
+            while len(results) < 3 and time.monotonic() < deadline:
+                r, f = sc.wait_any(ids, timeout=5.0)
+                assert not f, f"unexpected failures {f}"
+                results.update(r)
+            assert set(results) == {"ja-1", "ja-2", "jb-1"}
+            assert all(v == 7.0 for v in results.values())
+            # session_stats routes to each session's home shard and sees
+            # the multi-homed worker's window there.
+            for sid in (sid_a, sid_b):
+                stats = sc.session_stats(sid)
+                assert stats["session"] == sid
+                assert stats["capacity"] >= 2
+            for sid in (sid_a, sid_b):
+                sc.close_session(sid)
+        finally:
+            if sc is not None:
+                sc.close()
+            if stop is not None:
+                stop.set()
+            if worker is not None:
+                worker.shutdown()
+            b1.stop()
+            b2.stop()
+
+    def test_rejects_host_and_urls_together(self):
+        with pytest.raises(ValueError):
+            SessionClient(host="127.0.0.1", port=1, broker_urls=["a:1", "b:2"])
+
+
+class TestShardedBrokerFacade:
+    def test_submit_gather_across_shards(self):
+        b1 = JobBroker(host="127.0.0.1", port=0).start()
+        b2 = JobBroker(host="127.0.0.1", port=0).start()
+        urls = [f"127.0.0.1:{b.address[1]}" for b in (b1, b2)]
+        worker = stop = facade = None
+        try:
+            worker, stop, _ = _spawn_multihome_worker(
+                OneMax, urls, "fc-w0", capacity=2)
+            facade = ShardedBroker(urls)
+            payload = {
+                "genes": {"S_1": [1, 1, 1, 1, 1, 1], "S_2": [0, 0, 0, 0, 0, 0]},
+                "additional_parameters": {"nodes": (4, 4)},
+            }
+            sessions = [facade.open_session() for _ in range(3)]
+            ids = []
+            for i, sess in enumerate(sessions):
+                jid = f"fj-{i}"
+                facade.submit({jid: payload}, session=sess)
+                ids.append(jid)
+            results = facade.gather(ids, timeout=30.0)
+            assert {k: v for k, v in results.items()} == {
+                jid: 6.0 for jid in ids}
+            for sess in sessions:
+                facade.close_session(sess)
+        finally:
+            if facade is not None:
+                facade.stop()
+            if stop is not None:
+                stop.set()
+            if worker is not None:
+                worker.shutdown()
+            b1.stop()
+            b2.stop()
+
+
+class TestShardedPopulationEquality:
+    def test_two_shard_ga_matches_single_broker(self):
+        # The headline invariant: session-affine placement means a search
+        # sees ONE broker's FIFO/DRR semantics regardless of fleet shape,
+        # so a 2-shard run is bit-identical to the single-broker run.
+        b1 = JobBroker(host="127.0.0.1", port=0).start()
+        b2 = JobBroker(host="127.0.0.1", port=0).start()
+        urls = [f"127.0.0.1:{b.address[1]}" for b in (b1, b2)]
+        worker = stop = pop = None
+        ref = ref_worker = ref_stop = None
+        try:
+            worker, stop, _ = _spawn_multihome_worker(
+                OneMax, urls, "eq-w0", capacity=2)
+            pop = DistributedPopulation(OneMax, size=6, seed=42,
+                                        maximize=True, broker_urls=urls,
+                                        session="eq-session")
+            GeneticAlgorithm(pop, seed=7).run(2)
+
+            ref = DistributedPopulation(OneMax, size=6, seed=42,
+                                        maximize=True, port=0)
+            ref_stop = threading.Event()
+            ref_worker = GentunClient(
+                OneMax, *DATA, host="127.0.0.1",
+                port=ref.broker_address[1], capacity=2,
+                worker_id="eq-ref-w0", heartbeat_interval=0.2)
+            threading.Thread(
+                target=lambda: ref_worker.work(stop_event=ref_stop),
+                daemon=True).start()
+            GeneticAlgorithm(ref, seed=7).run(2)
+
+            assert [i.get_fitness() for i in pop.individuals] \
+                == [i.get_fitness() for i in ref.individuals]
+            assert pop.get_fittest().get_fitness() \
+                == ref.get_fittest().get_fitness()
+        finally:
+            for p in (pop, ref):
+                if p is not None:
+                    p.close()
+            for e in (stop, ref_stop):
+                if e is not None:
+                    e.set()
+            for c in (worker, ref_worker):
+                if c is not None:
+                    c.shutdown()
+            b1.stop()
+            b2.stop()
+
+    def test_single_url_list_behaves_like_host_port(self):
+        # A one-element broker_urls list degenerates to the classic
+        # host/port client (no router, no facade) — the zero-cost
+        # migration path DISTRIBUTED.md promises.
+        broker = JobBroker(host="127.0.0.1", port=0).start()
+        worker = stop = pop = None
+        try:
+            url = f"127.0.0.1:{broker.address[1]}"
+            worker, stop, _ = _spawn_multihome_worker(
+                OneMax, [url], "su-w0", capacity=2)
+            assert worker._addrs is None  # single-URL: classic path
+            pop = DistributedPopulation(OneMax, size=4, seed=3,
+                                        maximize=True, broker_urls=[url])
+            pop.evaluate()
+            for ind in pop.individuals:
+                assert ind.get_fitness() == float(
+                    sum(sum(g) for g in ind.genes.values()))
+        finally:
+            if pop is not None:
+                pop.close()
+            if stop is not None:
+                stop.set()
+            if worker is not None:
+                worker.shutdown()
+            broker.stop()
