@@ -51,6 +51,7 @@ from ..telemetry import lineage as _lineage
 from ..telemetry import spans as _tele
 from ..telemetry.registry import get_registry as _get_registry
 from .journal import DispatchJournal, replay_file
+from .packing import WindowPacker
 from .protocol import (
     MAX_MESSAGE_BYTES,
     WIRE_CAPS,
@@ -62,6 +63,8 @@ from .protocol import (
     encode,
     jobs2_frame,
     jobs_frame,
+    pack_envelope,
+    packed_entry2,
     parse_caps,
 )
 from .sessions import (
@@ -270,6 +273,8 @@ class JobBroker:
         admission_rate: Optional[float] = None,
         admission_burst: Optional[float] = None,
         admission_queue_factor: Optional[float] = None,
+        pack_windows: bool = False,
+        pack_linger_ms: float = 50.0,
     ):
         self._host = host
         self._port = port
@@ -325,6 +330,15 @@ class JobBroker:
             None if admission_queue_factor is None else float(admission_queue_factor))
         self._admission_buckets: Dict[str, tuple] = {}
         self._admission_rejections: Dict[str, int] = {}
+        # Cross-session window packing (ISSUE 19, packing.py): OFF by
+        # default — _packer is None ⇔ _dispatch takes the original path
+        # and every frame stays byte-identical to a pack-off build.
+        # Loop-thread state, like the scheduler.
+        self._pack_windows = bool(pack_windows)
+        self._pack_linger_s = max(0.0, float(pack_linger_ms) / 1000.0)
+        self._packer: Optional[WindowPacker] = (
+            WindowPacker(self._pack_linger_s) if self._pack_windows else None)
+        self._pack_timer: Optional[asyncio.TimerHandle] = None
 
         # Loop-thread state.  A job is "open" iff its id is in _payloads:
         # the first result pops the payload, and every other path (dispatch,
@@ -457,6 +471,9 @@ class JobBroker:
         self._thread = None
         self._loop = None
         self._started.clear()
+        # The linger timer handle belongs to the dead loop; a restart's
+        # first dispatch re-arms on the new one.
+        self._pack_timer = None
         if self._journal is not None:
             # Clean shutdown: final batched fsync.  (kill() abandons the
             # buffer FIRST, so a killed broker's journal truly loses its
@@ -503,6 +520,12 @@ class JobBroker:
         self._tele_dispatched.clear()
         self._workers.clear()
         self._admission_buckets.clear()
+        # Held pack windows die with the boot: the journal never saw a
+        # dispatch for them, so replay returns them to the scheduler and
+        # the fresh packer simply re-packs.
+        if self._pack_windows:
+            self._packer = WindowPacker(self._pack_linger_s)
+        self._pack_timer = None
         self._journal = None
         self._boot_id = None
 
@@ -916,6 +939,8 @@ class JobBroker:
         # connected nothing else pops the queues, and a retry loop would
         # grow them by one generation per attempt.
         self._sched.remove(ids)
+        if self._packer is not None:
+            self._packer.remove(ids)
         for w in self._workers.values():
             # Restore the credit _dispatch deducted for cancelled jobs,
             # so the worker's next batch isn't shrunk for one cycle.
@@ -1228,6 +1253,10 @@ class JobBroker:
             # Wire records share it too (encode-once fast path): a leak
             # here would pin payload bytes past job completion.
             "job_wires": len(self._job_wire),
+            # Pack-held jobs are neither queued nor in flight; the linger
+            # deadline bounds how long one may sit here, so at quiescence
+            # this too must be zero.
+            "packed_held": self._packer.held if self._packer is not None else 0,
         }
 
     @staticmethod
@@ -1368,7 +1397,15 @@ class JobBroker:
         probes → preemptible, everything else → stable), and the pass
         repeats while it makes progress so a head-of-queue job unblocked
         mid-pass still reaches a worker visited earlier.
+
+        With cross-session window packing on (``pack_windows=True``) the
+        whole pass is delegated to :meth:`_dispatch_packed` — the branch
+        sits BEFORE the empty-queue fast return because the packer may
+        hold linger-due jobs even when the scheduler is drained.
         """
+        if self._packer is not None:
+            self._dispatch_packed()
+            return
         if self._sched.depth() == 0:
             return
         tele = _tele.enabled()
@@ -1512,6 +1549,264 @@ class JobBroker:
                 break
         if tele:
             self._update_flow_gauges()
+
+    # -- cross-session window packing (ISSUE 19, packing.py) ---------------
+
+    def _pack_key(self, job_id: str) -> tuple:
+        """The compile-compatibility key for one open job:
+        ``(pack_envelope(env), job_size_class)`` — serialized static
+        config + fidelity bytes, plus the genome size class.  Equal keys
+        ⇒ the jobs compile to the same program and may share a window
+        (purity argument: DISTRIBUTED.md "Cross-session window packing").
+        """
+        jw = self._job_wire.get(job_id)
+        if jw is None:  # defensive: open job without a wire record
+            jw = build_job_wire(job_id, self._payloads[job_id],
+                                self._job_genome.get(job_id)
+                                or genome_key(self._payloads[job_id].get("genes")),
+                                self._frag_cache)
+            self._job_wire[job_id] = jw
+        sclass = job_size_class(
+            self._payloads[job_id].get("additional_parameters"))
+        return (pack_envelope(jw.env), sclass)
+
+    def _pack_step(self, w: _Worker, size_class: str) -> int:
+        """The packed-window target size for (worker, size class): the
+        worker's capacity, mesh-aligned EXACTLY like the client's
+        ``_chunk_jobs`` (round down to a multiple of the pop axis, floor
+        at one row) so a packed frame is one evaluation chunk — never
+        re-split worker-side.  Big/micro genomes never pack: the chunker
+        makes them singleton windows, so the broker does too."""
+        if size_class != SIZE_SMALL:
+            return 1
+        step = max(1, int(w.capacity))
+        pop = int((w.mesh or {}).get("pop") or 1)
+        if pop > 1 and step % pop:
+            step = max(pop, step - step % pop)
+        return step
+
+    def _dispatch_packed(self) -> None:
+        """The pack-mode dispatch pass: FILL then FLUSH then re-arm.
+
+        FILL drains the fair-share scheduler into the packer's
+        compatibility groups — through ``pop_next``, so the weighted DRR
+        deficit is charged job-by-job in exactly the order an unpacked
+        dispatch would have charged it, and session quotas count
+        packer-held jobs as in flight.  Fill is bounded by the fleet's
+        spare credit: with no worker able to take a window there is no
+        reason to pull work out of the (observable, fair) queue.
+
+        FLUSH hands each worker whole windows: a group ships when it can
+        fill the worker's mesh-aligned capacity (``_pack_step``) or when
+        its oldest job has lingered past the deadline — a lone
+        latency-sensitive job never waits for fill beyond
+        ``pack_linger_ms``.  In a mixed stable+preemptible fleet a group
+        only lands on its placement class (rung-0 small probes →
+        preemptible), same rule as the unpacked pass.
+
+        Whatever still waits on its linger deadline re-arms the loop
+        timer (:meth:`_arm_pack_timer`); a due-but-creditless group
+        flushes on the next ready-triggered dispatch instead.
+        """
+        packer = self._packer
+        now = time.monotonic()
+        workers = [w for w in self._workers.values() if not w.draining]
+        # -- fill ----------------------------------------------------------
+        if self._sched.depth():
+            spare = sum(w.credit for w in workers)
+            inflight = self._inflight_by_session()
+            for sid, n in packer.held_by_session().items():
+                inflight[sid] = inflight.get(sid, 0) + n
+            quotas = {s.session_id: s.max_in_flight
+                      for s in self._registry.list()
+                      if s.max_in_flight is not None}
+
+            def eligible(sid: str) -> bool:
+                quota = quotas.get(sid)
+                return quota is None or inflight.get(sid, 0) < quota
+
+            while packer.held < spare:
+                nxt = self._sched.pop_next(
+                    eligible, lambda j: j in self._payloads, None)
+                if nxt is None:
+                    break
+                sid, job_id = nxt
+                inflight[sid] = inflight.get(sid, 0) + 1
+                key = self._pack_key(job_id)
+                packer.add(sid, job_id, key, key[1],
+                           self.job_prefers_preemptible(job_id), now)
+        # -- flush ---------------------------------------------------------
+        placement_on = (any(w.preemptible for w in workers)
+                        and any(not w.preemptible for w in workers))
+        while True:
+            progress = False
+            for w in workers:
+                if w.credit <= 0:
+                    continue
+                for g in packer.groups():
+                    if w.credit <= 0:
+                        break
+                    if not g.jobs:
+                        continue
+                    if placement_on and g.prefers_preemptible != w.preemptible:
+                        continue
+                    step = self._pack_step(w, g.size_class)
+                    due = (now - g.arrivals[0]) >= packer.linger_s
+                    if len(g.jobs) < step and not due:
+                        continue
+                    window = packer.take(g, min(len(g.jobs), step, w.credit),
+                                         step, now)
+                    if window:
+                        self._send_packed_window(w, window, g.key[0])
+                        progress = True
+            if not progress:
+                break
+        self._arm_pack_timer(now)
+        if _tele.enabled():
+            self._update_flow_gauges()
+
+    def _send_packed_window(self, w: _Worker, window: List[tuple],
+                            pack_env: tuple) -> None:
+        """Per-job dispatch bookkeeping + ONE packed frame.
+
+        The per-job half mirrors the unpacked ``_dispatch`` body line for
+        line — journal dispatch record, size-class counter, queue-wait
+        span + histogram, dispatch-RTT stamp, lineage, watchdog — so every
+        demux path downstream (result, requeue, quarantine, replay) keeps
+        its session attribution untouched.  The frame half ships the whole
+        window as one ``packed: true`` frame: ``jobs2`` workers get the
+        compile envelope hoisted with per-job session/trace in the entries
+        (``packed_entry2``), v1 workers get the session-tagged v1 entries.
+        """
+        tele = _tele.enabled()
+        ops = _health.enabled()
+        jrn = self._journal
+        packer = self._packer
+        reg = _get_registry()
+        batch: List[JobWire] = []
+        for sid, job_id in window:
+            w.credit -= 1
+            w.in_flight.add(job_id)
+            if jrn is not None:
+                jrn.record_dispatch(job_id)
+            reg.counter(
+                "jobs_dispatched_total",
+                genome_size_class=job_size_class(
+                    self._payloads[job_id].get("additional_parameters"),
+                    int((w.mesh or {}).get("devices") or 1)),
+            ).inc()
+            reg.counter("packed_jobs_total", session=sid).inc()
+            if tele:
+                attrs = {"worker": w.worker_id}
+                if sid != DEFAULT_SESSION:
+                    attrs["session"] = sid
+                t_enq = self._tele_enqueued.get(job_id)
+                if t_enq is not None:
+                    wait = time.monotonic() - t_enq
+                    _tele.record_span(
+                        "queue_wait", t_enq, wait,
+                        trace=self._payloads[job_id].get("trace"),
+                        attrs=attrs,
+                    )
+                    if sid != DEFAULT_SESSION:
+                        reg.histogram("queue_wait_s", session=sid).observe(wait)
+                    else:
+                        reg.histogram("queue_wait_s").observe(wait)
+                self._tele_dispatched[job_id] = time.monotonic()
+            if _lineage.enabled():
+                pl = self._payloads[job_id]
+                _lineage.record(
+                    "dispatched", self._job_genome.get(job_id),
+                    job=job_id, worker=w.worker_id,
+                    rung=(pl.get("fidelity") or {}).get("rung", 0),
+                    session=sid if sid != DEFAULT_SESSION else None)
+            if ops:
+                self._watchdog.job_started(
+                    job_id, w.worker_id,
+                    session=sid if sid != DEFAULT_SESSION else None)
+            jw = self._job_wire.get(job_id)
+            if jw is None:  # defensive: open job without a record
+                jw = build_job_wire(job_id, self._payloads[job_id],
+                                    self._job_genome.get(job_id)
+                                    or genome_key(self._payloads[job_id].get("genes")),
+                                    self._frag_cache)
+                self._job_wire[job_id] = jw
+            batch.append(jw)
+        # Defensive oversize split at the same soft cap as _dispatch; a
+        # window is at most one capacity of few-KB genomes, so in practice
+        # this is always a single frame (and every part stays <= the
+        # window, so the worker-side no-resplit assertion holds per frame).
+        soft_cap = MAX_MESSAGE_BYTES // 2
+        parts: List[List[JobWire]] = []
+        cur: List[JobWire] = []
+        cur_bytes = 0
+        for jw in batch:
+            if cur and cur_bytes + len(jw.v1) > soft_cap:
+                parts.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(jw)
+            cur_bytes += len(jw.v1)
+        parts.append(cur)
+        self._encode_samples += 1
+        sample = (self._encode_samples & 63) == 0
+        t0 = time.perf_counter() if sample else 0.0
+        if "jobs2" in w.caps:
+            frames = [("jobs2", jobs2_frame(
+                pack_env, [packed_entry2(jw) for jw in part], packed=True))
+                for part in parts]
+        else:
+            frames = [("jobs", jobs_frame([jw.v1 for jw in part], packed=True))
+                      for part in parts]
+        if sample:
+            self._note_encode(time.perf_counter() - t0)
+        for mtype, data in frames:
+            try:
+                if self._injector is not None and \
+                        self._injector.broker_send(w, decode(data)):
+                    continue
+                w.writer.write(data)
+            except Exception:  # connection already broken; reader cleans up
+                logger.debug("write to worker %s failed", w.worker_id,
+                             exc_info=True)
+                continue
+            self._note_wire(mtype, len(data))
+        reg.counter("packed_windows_total").inc()
+        reg.histogram("pack_fill_ratio").observe(packer.fill_ratios[-1])
+        reg.histogram("pack_linger_seconds").observe(packer.lingers[-1])
+
+    def _arm_pack_timer(self, now: float) -> None:
+        """(Re)arm the loop timer for the earliest linger deadline.
+
+        Only future deadlines get a precise timer.  A deadline already in
+        the past here means the flush pass just declined the window (no
+        credit / wrong placement class); the next worker `ready` triggers
+        a dispatch anyway, and a linger-cadence backstop poll guarantees
+        a lone held job never waits on worker timing alone.
+        """
+        if self._pack_timer is not None:
+            self._pack_timer.cancel()
+            self._pack_timer = None
+        deadline = self._packer.next_deadline()
+        if deadline is None or self._loop is None:
+            return
+        delay = deadline - now
+        if delay <= 0:
+            delay = max(self._packer.linger_s, 0.01)
+        self._pack_timer = self._loop.call_later(delay, self._pack_timer_fire)
+
+    def _pack_timer_fire(self) -> None:
+        self._pack_timer = None
+        if not self._stopping:
+            self._dispatch()
+
+    def pack_stats(self) -> Optional[Dict[str, Any]]:
+        """Pack-plane snapshot (``None`` when ``pack_windows=False``):
+        windows/jobs/cross-session totals, currently-held count, and
+        fill-ratio + linger percentile distributions.  Also surfaced in
+        ``/statusz`` under ``fleet.packing`` for gentun_top."""
+        if self._packer is None:
+            return None
+        return self._packer.snapshot()
 
     def _send(self, w: _Worker, msg: Dict[str, Any]) -> None:
         try:
@@ -1808,6 +2103,9 @@ class JobBroker:
                 "queue_factor": self._admission_queue_factor,
                 "rejected_by_session": dict(self._admission_rejections),
             },
+            # Cross-session window packing (ISSUE 19): None ⇔ packing off
+            # (no new statusz noise for the default build).
+            "packing": self.pack_stats(),
         }
 
     async def _handle_worker(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:

@@ -1285,9 +1285,7 @@ class GentunClient:
                         # evaluation group).  A jobs2 frame expands its
                         # shared envelope once (protocol.py "Wire fast
                         # path") before the same chunking.
-                        jobs = (list(msg["jobs"]) if msg["type"] == "jobs"
-                                else expand_jobs2(msg))
-                        for chunk in self._chunk_jobs(jobs):
+                        for chunk in self._chunk_frame(msg):
                             ready_q.put(chunk)
                     elif msg["type"] != "welcome":
                         logger.warning("unexpected message %r", msg["type"])
@@ -1374,6 +1372,29 @@ class GentunClient:
             step = max(pop, step - step % pop)
         chunks = [small[i:i + step] for i in range(0, len(small), step)]
         chunks.extend(narrow)
+        return chunks
+
+    def _chunk_frame(self, msg: Dict[str, Any]) -> List[List[Dict[str, Any]]]:
+        """Expand one ``jobs``/``jobs2`` frame and chunk it for evaluation.
+
+        A frame marked ``packed: true`` was sized broker-side as ONE
+        mesh-aligned evaluation window (cross-session window packing,
+        DISTRIBUTED.md) — it must come back from ``_chunk_jobs`` as
+        exactly one chunk.  If it does not, the broker's capacity mirror
+        (``_pack_step``) and this worker's advertisement disagree: log
+        loudly, bump ``packed_window_resplit_total``, and evaluate the
+        chunks anyway — degraded amortization, never dropped work.
+        """
+        jobs = (list(msg["jobs"]) if msg["type"] == "jobs"
+                else expand_jobs2(msg))
+        chunks = self._chunk_jobs(jobs)
+        if msg.get("packed") is True and len(chunks) > 1:
+            logger.error(
+                "packed window of %d job(s) re-split into %d evaluation "
+                "chunks on worker %s (capacity %d): broker and worker "
+                "disagree on the window size; evaluating anyway",
+                len(jobs), len(chunks), self.worker_id, self.capacity)
+            _get_registry().counter("packed_window_resplit_total").inc()
         return chunks
 
     def _await_jobs(self) -> List[Dict[str, Any]]:

@@ -287,6 +287,28 @@ DISTRIBUTED.md "Wire fast path"):
   identical to ``encode({"job_id": ..., **payload})``, which the
   back-compat tests pin, so fault injectors and v1 workers observe
   exactly the frames a pre-fast-path broker produced.
+
+Cross-session window packing (same OPTIONAL convention — DISTRIBUTED.md
+"Cross-session window packing"):
+
+- a ``jobs``/``jobs2`` frame may carry top-level ``packed: true``: the
+  broker sized this window as ONE evaluation batch (already
+  mesh-aligned to the receiving worker's capacity), coalescing jobs
+  from different sessions that share a compile-compatible envelope.  A
+  packing-aware worker asserts the frame never re-splits in
+  ``_chunk_jobs`` (``packed_window_resplit_total`` counts violations —
+  degrade loudly, never drop); an old worker ignores the unknown key
+  and chunks as always, which is safe because a packed window is never
+  larger than the worker's advertised capacity.  The marker is emitted
+  ONLY by a ``JobBroker(pack_windows=True)`` — a pack-off broker's
+  frames stay byte-identical to this build's predecessors.
+- a packed ``jobs2`` frame hoists only :data:`PACK_ENVELOPE_FIELDS`
+  (``additional_parameters``, ``fidelity`` — the compile-compatibility
+  envelope) into ``shared``; the per-job tenant fields (``session``,
+  ``trace``) ride each entry instead (``packed_entry2``).
+  ``expand_jobs2`` already lets per-entry keys override the envelope,
+  so expansion is lossless and per-job session attribution survives
+  the shared hoist.
 """
 
 from __future__ import annotations
@@ -312,6 +334,9 @@ __all__ = [
     "jobs_frame",
     "jobs2_frame",
     "expand_jobs2",
+    "PACK_ENVELOPE_FIELDS",
+    "pack_envelope",
+    "packed_entry2",
     "PreencodedMessage",
 ]
 
@@ -404,6 +429,16 @@ SHARED_ENVELOPE_FIELDS: Tuple[str, ...] = (
     "additional_parameters", "fidelity", "trace", "session")
 
 _SHARED_SET = frozenset(SHARED_ENVELOPE_FIELDS)
+
+#: The compile-compatibility slice of the envelope — the fields whose
+#: serialized bytes must match for two jobs to share one packed device
+#: window (static config fingerprint + fidelity fingerprint; the genome
+#: size class rides alongside in the broker's pack key).  ``trace`` and
+#: ``session`` are deliberately absent: they are per-tenant attribution,
+#: not compile inputs, and stay per-entry in a packed frame.
+PACK_ENVELOPE_FIELDS: Tuple[str, ...] = ("additional_parameters", "fidelity")
+
+_PACK_SET = frozenset(PACK_ENVELOPE_FIELDS)
 
 #: Fixed framing bytes around a single-entry ``jobs`` frame — used to give
 #: submit-time oversize validation the exact byte count ``encode()`` saw.
@@ -589,18 +624,51 @@ def _finish_frame(body: bytes) -> bytes:
     return body + b"\n"
 
 
-def jobs_frame(entries: Iterable[bytes]) -> bytes:
+def jobs_frame(entries: Iterable[bytes], packed: bool = False) -> bytes:
     """Join v1 entry bytes into one ``jobs`` frame — byte-identical to
-    ``encode({"type": "jobs", "jobs": [...]})`` over the decoded entries."""
-    return _finish_frame(b'{"type":"jobs","jobs":[' + b",".join(entries) + b"]}")
+    ``encode({"type": "jobs", "jobs": [...]})`` over the decoded entries.
+    ``packed=True`` adds the ``"packed":true`` marker (cross-session
+    window packing); the default path's bytes are untouched, which is
+    what makes a pack-off broker wire-byte-identical by construction."""
+    head = (b'{"type":"jobs","packed":true,"jobs":[' if packed
+            else b'{"type":"jobs","jobs":[')
+    return _finish_frame(head + b",".join(entries) + b"]}")
 
 
 def jobs2_frame(env: Iterable[Tuple[str, bytes]],
-                entries: Iterable[bytes]) -> bytes:
-    """Join a shared envelope + ``jobs2`` entry bytes into one frame."""
+                entries: Iterable[bytes], packed: bool = False) -> bytes:
+    """Join a shared envelope + ``jobs2`` entry bytes into one frame.
+    ``packed=True`` marks a broker-sized cross-session window (see
+    :func:`jobs_frame`); the envelope should then be the
+    :func:`pack_envelope` slice with per-job fields in the entries."""
     shared = b",".join(_key_bytes(k) + b":" + v for k, v in env)
-    return _finish_frame(b'{"type":"jobs2","shared":{' + shared +
+    head = (b'{"type":"jobs2","packed":true,"shared":{' if packed
+            else b'{"type":"jobs2","shared":{')
+    return _finish_frame(head + shared +
                          b'},"jobs":[' + b",".join(entries) + b"]}")
+
+
+def pack_envelope(env: Iterable[Tuple[str, bytes]]) -> Tuple[Tuple[str, bytes], ...]:
+    """The compile-compatibility slice of a :class:`JobWire` envelope:
+    only :data:`PACK_ENVELOPE_FIELDS`, in envelope order.  Equality of
+    this tuple (serialized bytes, not parsed values) is the broker's
+    pack-compatibility test — the same exact-value grouping rule
+    ``jobs2`` hoisting already relies on."""
+    return tuple((k, v) for k, v in env if k in _PACK_SET)
+
+
+def packed_entry2(jw: "JobWire") -> bytes:
+    """A ``jobs2`` entry for a PACKED (cross-session) window: the cached
+    ``entry2`` plus the per-tenant envelope fields (``session``,
+    ``trace``) a packed frame cannot hoist into ``shared``.
+    ``expand_jobs2`` lets per-entry keys override the envelope, so the
+    worker reconstructs exactly the per-job dicts an unpacked dispatch
+    would have produced — session attribution survives the hoist."""
+    extra = b"".join(b"," + _key_bytes(k) + b":" + v
+                     for k, v in jw.env if k not in _PACK_SET)
+    if not extra:
+        return jw.entry2
+    return jw.entry2[:-1] + extra + b"}"
 
 
 def expand_jobs2(msg: Dict[str, Any]) -> List[Dict[str, Any]]:

@@ -752,6 +752,68 @@ def run_placement_gate(per_job_dispatch_us: float) -> dict:
     }
 
 
+def run_pack_gate(per_job_dispatch_us: float) -> dict:
+    """Window-packer cost per job on the dispatch path, micro-timed.
+
+    With ``pack_windows=True`` every dispatched job pays exactly three
+    packer touches: one pack-key assembly (filter the cached envelope
+    tuple through ``pack_envelope`` + one memoized ``job_size_class``
+    call), one ``WindowPacker.add`` (deque append + dict upkeep), and a
+    1/step share of the window ``take`` (deque pops + one stats sample
+    per window).  The loop below runs that full add→take lifecycle over
+    a realistic two-tenant stream at a capacity-8 window step — the
+    fill/flush policy around it reuses the same ``pop_next``/credit
+    bookkeeping the unpacked path already pays, so the packer's own
+    touches ARE the added cost.  Same instrument as the other gates:
+    batched min-of-repeats divided by the measured per-job dispatch
+    cost."""
+    from gentun_tpu.distributed.packing import WindowPacker
+    from gentun_tpu.distributed.protocol import (
+        GenomeFragmentCache,
+        build_job_wire,
+        pack_envelope,
+    )
+    from gentun_tpu.parallel.mesh import job_size_class
+
+    params = {"nodes": (4, 4)}
+    cache = GenomeFragmentCache()
+    n, step = 2048, 8
+    jobs = []
+    for i in range(n):
+        payload = {
+            "genes": {"S_1": [0, 1, 0, 1, 0, 1], "S_2": [1, 0, 1, 0, 1, 0]},
+            "additional_parameters": params,
+        }
+        jw = build_job_wire(f"p{i}", payload, f"gk{i % 64}", cache)
+        jobs.append((f"t{i % 2}", f"p{i}", jw, payload))
+    job_size_class(params)  # warm the memo (steady state, like dispatch)
+    packer = WindowPacker(0.05)
+
+    def _loop():
+        for sid, jid, jw, payload in jobs:
+            key = (pack_envelope(jw.env),
+                   job_size_class(payload.get("additional_parameters")))
+            packer.add(sid, jid, key, key[1], True, 0.0)
+            if packer.held >= step:
+                packer.take(packer.groups()[0], step, step, 0.0)
+        for g in packer.groups():  # drain the tail window
+            packer.take(g, len(g), step, 0.0)
+
+    reps, inner = 3, 10
+    per_job_s = min(timeit.repeat(_loop, number=inner, repeat=reps)) / (
+        inner * n)
+    per_job_added_us = round(per_job_s * 1e6, 3)
+    overhead_pct = round(per_job_added_us / per_job_dispatch_us * 100.0, 3)
+    return {
+        "window_step": step,
+        "per_job_added_us": per_job_added_us,
+        "per_job_dispatch_us": per_job_dispatch_us,
+        "overhead_pct": overhead_pct,
+        "gate_max_pct": 2.0,
+        "within_gate": overhead_pct <= 2.0,
+    }
+
+
 def _measure_broker_rate(broker, n_jobs: int, n_workers: int,
                          capacity: int) -> float:
     """Jobs/sec through ONE live broker with its own fresh workers.
@@ -926,6 +988,8 @@ def _print_hot_path_table(out: dict) -> None:
          f"{out['placement']['overhead_pct']}% of dispatch"),
         ("shard route (ring home)", out["shard_route"]["per_job_added_us"],
          f"{out['shard_route']['overhead_pct']}% of dispatch"),
+        ("window packer (pack on)", out["packing"]["per_job_added_us"],
+         f"{out['packing']['overhead_pct']}% of dispatch"),
     ]
     w = max(len(r[0]) for r in rows)
     print(f"\nper-job hot-path cost ({out['n_workers']} workers, "
@@ -1058,6 +1122,16 @@ def main() -> dict:
         f"exceeds the 2% gate ({out['shard_route']['per_job_added_us']}us "
         f"added on {out['shard_route']['per_job_dispatch_us']}us/job "
         f"dispatch)")
+
+    # Window-packing gate (DISTRIBUTED.md "Cross-session window
+    # packing"): the per-job pack-key + packer add/take bookkeeping a
+    # pack_windows=True broker adds to the dispatch hot path must also
+    # stay <=2% of per-job dispatch cost.  Same denominator again.
+    out["packing"] = run_pack_gate(out["forensics"]["per_job_dispatch_us"])
+    assert out["packing"]["within_gate"], (
+        f"window-packer overhead {out['packing']['overhead_pct']}% "
+        f"exceeds the 2% gate ({out['packing']['per_job_added_us']}us "
+        f"added on {out['packing']['per_job_dispatch_us']}us/job dispatch)")
 
     # Horizontal shard curve (DISTRIBUTED.md "Horizontal broker
     # sharding"): aggregate throughput at 1/2/4 resident shards, each

@@ -1745,6 +1745,125 @@ def run_preemption_act() -> dict:
     }
 
 
+def run_packing_act() -> dict:
+    """Packing chaos act (ISSUE 19, DISTRIBUTED.md "Cross-session window
+    packing"): two tenant searches share a ``pack_windows=True`` broker,
+    so their per-generation batches coalesce into cross-session windows —
+    and the worker's connection is dropped on a received packed ``jobs2``
+    frame, i.e. mid-packed-window, before any job in it evaluates.  The
+    whole window (jobs from BOTH sessions) must requeue through the
+    per-job disconnect path, re-pack, and land exactly once per session:
+    each tenant finishes bit-identical to its single-process solo
+    reference, per-session books show completed == submitted with zero
+    failures/quarantines, and the broker ends quiescent including the
+    pack plane (``packed_held`` drains to zero)."""
+    mutation_rate = 0.5  # novel genomes every generation: windows stay live
+
+    # Per-tenant solo references: single-process, different population
+    # seeds so the tenants' genomes (and windows) genuinely differ.
+    tenants = (("pack-a", POP_SEED), ("pack-b", POP_SEED + 1))
+    refs = {}
+    for tag, pseed in tenants:
+        ref = GeneticAlgorithm(
+            Population(SlowishOneMax, *DATA, size=POP_SIZE, seed=pseed,
+                       mutation_rate=mutation_rate), seed=GA_SEED)
+        ref.run(GENERATIONS)
+        refs[tag] = _snapshot(ref)
+
+    # With packing on, every job frame the broker ships is a packed
+    # window, so any received ``jobs2`` is one.  ``at=1`` lets the first
+    # window land cleanly, then severs the second mid-delivery.
+    drop_inj = FaultInjector(FaultPlan([
+        FaultSpec(hook="client_recv", kind="drop_connection",
+                  match_type="jobs2", at=1),
+    ], seed=2028))
+
+    port = _free_port()
+    broker = JobBroker(port=port, pack_windows=True,
+                       pack_linger_ms=50.0).start()
+
+    # One worker whose capacity spans both tenants' generations, so a
+    # full cross-session window fits in a single frame.
+    stop = threading.Event()
+    client = GentunClient(
+        SlowishOneMax, *DATA, host="127.0.0.1", port=port,
+        worker_id="pack-chaos-w0", capacity=2 * POP_SIZE,
+        heartbeat_interval=0.2, reconnect_delay=0.05,
+        reconnect_max_delay=0.5, fault_injector=drop_inj)
+    threading.Thread(target=lambda: client.work(stop_event=stop),
+                     daemon=True).start()
+
+    snaps: dict = {}
+    errs: dict = {}
+    t0 = time.monotonic()
+    try:
+        def _tenant(tag, pseed):
+            try:
+                pop = DistributedPopulation(
+                    OneMax, size=POP_SIZE, seed=pseed,
+                    mutation_rate=mutation_rate, host="127.0.0.1",
+                    port=port, broker=broker, session=tag, job_timeout=120)
+                try:
+                    ga = GeneticAlgorithm(pop, seed=GA_SEED)
+                    ga.run(GENERATIONS)
+                    snaps[tag] = _snapshot(ga)
+                finally:
+                    pop.close()
+            except Exception as e:  # noqa: BLE001 — surfaced in asserts
+                errs[tag] = repr(e)
+
+        threads = [threading.Thread(target=_tenant, args=t, daemon=True)
+                   for t in tenants]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        wall = time.monotonic() - t0
+        leaked = broker.outstanding()
+        pack = broker.pack_stats()
+        books = broker.session_stats()
+    finally:
+        stop.set()
+        broker.stop()
+
+    assert not errs, f"tenant search(es) died: {errs}"
+    assert set(snaps) == {t for t, _ in tenants}, f"missing snaps: {snaps}"
+    assert drop_inj.fired, "the mid-packed-window drop never fired"
+    identical = {tag: snaps[tag] == refs[tag] for tag, _ in tenants}
+    assert all(identical.values()), (
+        f"packed run diverged from solo references: {identical}")
+    assert pack is not None and pack["windows_total"] >= 1, pack
+    assert pack["cross_session_windows"] >= 1, (
+        f"tenants never shared a window: {pack}")
+    assert all(v == 0 for v in leaked.values()), f"leaked broker state: {leaked}"
+    requeued_total = 0
+    for tag, _ in tenants:
+        book = books[tag]
+        assert book["completed"] == book["submitted"], (
+            f"{tag}: {book['completed']}/{book['submitted']} landed")
+        assert book["failed"] == 0 and book["quarantined"] == 0, book
+        requeued_total += book["requeued"]
+    assert requeued_total >= 1, (
+        "the dropped window never requeued through the per-job path")
+
+    return {
+        "generations": GENERATIONS,
+        "population_size": POP_SIZE,
+        "seeds": {"ga": GA_SEED,
+                  "population": {tag: pseed for tag, pseed in tenants}},
+        "mutation_rate": mutation_rate,
+        "pack_linger_ms": 50.0,
+        "fault_plan": drop_inj.plan.to_dict(),
+        "faults_fired": list(drop_inj.fired),
+        "bit_identical_to_solo_references": identical,
+        "packing": pack,
+        "session_books": {tag: books[tag] for tag, _ in tenants},
+        "requeued_total": requeued_total,
+        "broker_state_after_final_gather": leaked,
+        "wall_s": round(wall, 3),
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
@@ -1759,6 +1878,7 @@ if __name__ == "__main__":
     out["broker_kill"] = run_broker_kill()
     out["shard_kill"] = run_shard_kill()
     out["preemption"] = run_preemption_act()
+    out["packing"] = run_packing_act()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

@@ -420,6 +420,37 @@ def render(base: str, healthz, statusz, metrics_text, color: bool) -> str:
                          f"hit-rate {rate} "
                          f"({frag.get('hits')}/{lookups} lookups){X}")
 
+    # Packing panel (DISTRIBUTED.md "Cross-session window packing"):
+    # present only when the broker runs pack_windows=True — window/job
+    # totals, the cross-session share (the whole point: >0 means tenants
+    # are actually amortizing the program-switch floor together), fill
+    # and linger percentiles from the pack plane, and the per-session
+    # packed-job split from the metrics counters.
+    packing = (statusz.get("fleet") or {}).get("packing")
+    if packing:
+        wt = packing.get("windows_total", 0) or 0
+        xs = packing.get("cross_session_windows", 0) or 0
+        share = f"{xs / wt:.0%}" if wt else "-"
+        fill = packing.get("fill_ratio") or {}
+        lng = packing.get("linger_s") or {}
+        lines.append(
+            f"{B}packing{X}  windows {wt} ({xs} cross-session, {share})  "
+            f"jobs {packing.get('jobs_total', 0)}  "
+            f"held {packing.get('held', 0)}/{packing.get('groups', 0)}g  "
+            f"linger-cap {packing.get('linger_ms', 0):g}ms")
+        if fill or lng:
+            lines.append(
+                f"  {D}fill p50 {fill.get('p50', 0):.2f} "
+                f"p90 {fill.get('p90', 0):.2f}  "
+                f"linger p50 {lng.get('p50', 0) * 1e3:.1f}ms "
+                f"p90 {lng.get('p90', 0) * 1e3:.1f}ms{X}")
+        pj = _parse_labeled(metrics_text or "", "packed_jobs_total", "session")
+        if pj:
+            parts = [f"{s or 'default'} {n:g}"
+                     for s, n in sorted(pj.items(), key=lambda kv: -kv[1])]
+            lines.append(f"  {D}packed jobs by session: "
+                         f"{'  '.join(parts[:6])}{X}")
+
     # Chip-hour cost panel (search forensics, docs/OBSERVABILITY.md): the
     # "cost" status provider exists only while the lineage plane is on —
     # measured device-seconds from the cost ledger, attributed to

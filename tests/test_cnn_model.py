@@ -584,6 +584,26 @@ class TestBatchCompositionPurity:
         assert (swapped[0], swapped[1], swapped[2]) == (batch[2], batch[1], batch[0])
         assert swapped[3] == batch[1] and swapped[4] == batch[0]  # in-batch twins too
 
+    def test_cross_session_packed_window_matches_solo_runs(self, separable_data):
+        """The correctness gate for cross-session window packing (ISSUE
+        19): two tenants' genomes interleaved slot-by-slot in ONE packed
+        device window score EXACTLY what each tenant's solo windows score.
+        This is the same purity invariant as above — batch composition is
+        not a fitness input — asserted in the shape the broker's packer
+        actually produces: a DRR-interleaved window of jobs from different
+        sessions sharing one compile envelope."""
+        x, y = separable_data
+        g = lambda bits: {"S_1": bits}
+        sess_a = [g((1, 0, 1)), g((0, 1, 0))]
+        sess_b = [g((1, 1, 0)), g((0, 0, 1))]
+        # One packed window, tenants interleaved: [a0, b0, a1, b1].
+        packed = GeneticCnnModel.cross_validate_population(
+            x, y, [sess_a[0], sess_b[0], sess_a[1], sess_b[1]], **FAST)
+        solo_a = GeneticCnnModel.cross_validate_population(x, y, sess_a, **FAST)
+        solo_b = GeneticCnnModel.cross_validate_population(x, y, sess_b, **FAST)
+        assert (packed[0], packed[2]) == (solo_a[0], solo_a[1])
+        assert (packed[1], packed[3]) == (solo_b[0], solo_b[1])
+
     def test_hashes_are_content_not_position(self):
         from gentun_tpu.models.cnn import _genome_hashes
 

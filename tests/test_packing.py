@@ -1,0 +1,563 @@
+"""Cross-session window packing (ISSUE 19).
+
+Compatibility-key grouping (static config + fidelity bytes + size
+class), linger-deadline flush, DRR deficit charging preserved job-by-job
+inside packed windows, journal replay of a packed in-flight window, the
+worker-side no-resplit assertion, and — the regression fence — pack-off
+wire byte-identity against a frame-capturing stub: a
+``JobBroker(pack_windows=False)`` must emit exactly the frames the
+pre-packing broker emitted.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gentun_tpu import Individual, Population, genetic_cnn_genome
+from gentun_tpu.distributed import GentunClient, JobBroker
+from gentun_tpu.distributed.packing import WindowPacker
+from gentun_tpu.distributed.protocol import (
+    PACK_ENVELOPE_FIELDS,
+    WIRE_CAPS,
+    GenomeFragmentCache,
+    build_job_wire,
+    decode,
+    encode,
+    expand_jobs2,
+    jobs2_frame,
+    jobs_frame,
+    pack_envelope,
+    packed_entry2,
+)
+from gentun_tpu.distributed.sessions import genome_key
+from gentun_tpu.telemetry import health as _health
+from gentun_tpu.telemetry import spans as spans_mod
+from gentun_tpu.telemetry.registry import get_registry
+
+
+class OneMax(Individual):
+    def build_spec(self, **params):
+        return genetic_cnn_genome(tuple(params.get("nodes", (4, 4))))
+
+    def evaluate(self):
+        return float(sum(sum(g) for g in self.genes.values()))
+
+
+DATA = (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    _health.disable()
+    _health.reset()
+    get_registry().reset()
+    yield
+    spans_mod.disable()
+    spans_mod.set_run_sink(None)
+    _health.disable()
+    _health.reset()
+    get_registry().reset()
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _counter_total(name):
+    snap = get_registry().snapshot()
+    return sum(c["value"] for c in snap["counters"] if c["name"] == name)
+
+
+def _genomes(n, seed=0):
+    pop = Population(OneMax, DATA, size=n, seed=seed, maximize=True)
+    return [ind.get_genes() for ind in pop]
+
+
+def _onemax_fitness(genes):
+    return float(sum(sum(g) for g in genes.values()))
+
+
+def _free_port():
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(species, port, worker_id, capacity=1):
+    stop = threading.Event()
+    client = GentunClient(
+        species, *DATA, host="127.0.0.1", port=port, capacity=capacity,
+        worker_id=worker_id, heartbeat_interval=0.2, reconnect_delay=0.05,
+    )
+    t = threading.Thread(target=lambda: client.work(stop_event=stop), daemon=True)
+    t.start()
+    return client, stop, t
+
+
+class _StubWorker:
+    """Frame-capturing wire worker: advertises capacity/caps, grants
+    credit, and records every raw frame the broker sends — never acks, so
+    dispatched windows stay in flight until the test decides."""
+
+    def __init__(self, port, worker_id="stub", capacity=4, caps=None,
+                 timeout=5.0):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.rfile = self.sock.makefile("rb")
+        hello = {"type": "hello", "worker_id": worker_id, "capacity": capacity}
+        if caps is not None:
+            hello["caps"] = list(caps)
+        self.send(hello)
+        self.welcome_raw = self.rfile.readline()
+        assert decode(self.welcome_raw).get("type") == "welcome"
+
+    def send(self, msg):
+        self.sock.sendall(encode(msg))
+
+    def ready(self, credit):
+        self.send({"type": "ready", "credit": credit})
+
+    def recv_raw(self):
+        line = self.rfile.readline()
+        if not line:
+            raise ConnectionError("broker closed connection")
+        return line
+
+    def close(self):
+        try:
+            self.rfile.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _payload(genes, params=None):
+    return {"genes": genes,
+            "additional_parameters": params or {"nodes": [4, 4]}}
+
+
+# ---------------------------------------------------------------------------
+# Pure unit: WindowPacker
+# ---------------------------------------------------------------------------
+
+
+class TestWindowPacker:
+    def test_add_groups_by_key_and_counts_held(self):
+        p = WindowPacker(0.05)
+        p.add("a", "j1", ("k1",), "small", True, now=1.0)
+        p.add("b", "j2", ("k1",), "small", True, now=1.1)
+        p.add("a", "j3", ("k2",), "small", True, now=1.2)
+        assert p.held == 3
+        assert p.held_by_session() == {"a": 2, "b": 1}
+        assert len(p.groups()) == 2
+        assert p.next_deadline() == pytest.approx(1.05)
+
+    def test_take_is_fifo_records_stats_and_drops_empty_group(self):
+        p = WindowPacker(0.05)
+        for i, sid in enumerate(["a", "b", "a", "b"]):
+            p.add(sid, f"j{i}", ("k",), "small", False, now=float(i))
+        g = p.groups()[0]
+        window = p.take(g, 4, 8, now=4.0)
+        assert window == [("a", "j0"), ("b", "j1"), ("a", "j2"), ("b", "j3")]
+        assert p.held == 0 and p.groups() == []
+        assert p.windows_total == 1 and p.jobs_total == 4
+        assert p.cross_session_windows == 1
+        assert p.fill_ratios[-1] == pytest.approx(0.5)  # 4 of step 8
+        assert p.lingers[-1] == pytest.approx(4.0)      # oldest arrival 0.0
+
+    def test_take_partial_leaves_tail_queued(self):
+        p = WindowPacker(0.05)
+        for i in range(5):
+            p.add("a", f"j{i}", ("k",), "small", False, now=float(i))
+        g = p.groups()[0]
+        assert [j for _, j in p.take(g, 3, 3, now=5.0)] == ["j0", "j1", "j2"]
+        assert p.held == 2
+        assert p.cross_session_windows == 0  # single tenant
+        assert [j for _, j in p.take(p.groups()[0], 3, 3, now=5.0)] == ["j3", "j4"]
+
+    def test_remove_purges_and_drops_empty_groups(self):
+        p = WindowPacker(0.05)
+        p.add("a", "j1", ("k1",), "small", True, now=1.0)
+        p.add("b", "j2", ("k1",), "small", True, now=1.0)
+        p.add("a", "j3", ("k2",), "small", True, now=1.0)
+        assert p.remove({"j2", "j3", "never-held"}) == 2
+        assert p.held == 1
+        assert [g.key for g in p.groups()] == [("k1",)]
+        assert [j for _, j in p.groups()[0].jobs] == ["j1"]
+
+    def test_snapshot_shape(self):
+        p = WindowPacker(0.025)
+        snap = p.snapshot()
+        assert snap["linger_ms"] == 25.0
+        assert snap["held"] == 0 and snap["fill_ratio"] is None
+        p.add("a", "j1", ("k",), "small", False, now=0.0)
+        p.take(p.groups()[0], 1, 4, now=0.01)
+        snap = p.snapshot()
+        assert snap["windows_total"] == 1
+        assert snap["fill_ratio"]["p50"] == pytest.approx(0.25)
+        assert snap["linger_s"]["max"] == pytest.approx(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Protocol: the compile-compatibility envelope and packed frames
+# ---------------------------------------------------------------------------
+
+
+class TestPackProtocol:
+    @staticmethod
+    def _wire(job_id, payload, sid=None):
+        jw = build_job_wire(job_id, payload, genome_key(payload["genes"]),
+                            GenomeFragmentCache())
+        return jw.with_session(sid) if sid else jw
+
+    def test_pack_envelope_slices_compile_fields_only(self):
+        assert PACK_ENVELOPE_FIELDS == ("additional_parameters", "fidelity")
+        payload = dict(_payload(_genomes(1)[0]),
+                       fidelity={"rung": 0, "epochs": 1},
+                       trace="t-1")
+        jw = self._wire("j1", payload, sid="tenant")
+        keys = [k for k, _ in pack_envelope(jw.env)]
+        assert keys == ["additional_parameters", "fidelity"]
+        # session/trace are per-tenant attribution, never compile inputs.
+        assert "session" in dict(jw.env) and "trace" in dict(jw.env)
+
+    def test_same_config_different_session_same_pack_envelope(self):
+        g = _genomes(2, seed=3)
+        a = self._wire("a0", _payload(g[0]), sid="a")
+        b = self._wire("b0", _payload(g[1]), sid="b")
+        assert pack_envelope(a.env) == pack_envelope(b.env)
+        c = self._wire("c0", _payload(g[0], params={"nodes": [3, 5]}), sid="c")
+        assert pack_envelope(c.env) != pack_envelope(a.env)
+        d = self._wire("d0", dict(_payload(g[0]), fidelity={"rung": 1}), sid="a")
+        assert pack_envelope(d.env) != pack_envelope(a.env)
+
+    def test_packed_jobs2_frame_expands_with_per_job_sessions(self):
+        g = _genomes(2, seed=4)
+        wires = [self._wire("a0", _payload(g[0]), sid="a"),
+                 self._wire("b0", _payload(g[1]), sid="b")]
+        frame = jobs2_frame(pack_envelope(wires[0].env),
+                            [packed_entry2(jw) for jw in wires], packed=True)
+        msg = decode(frame)
+        assert msg["type"] == "jobs2" and msg["packed"] is True
+        jobs = expand_jobs2(msg)
+        assert [j["session"] for j in jobs] == ["a", "b"]
+        assert [j["job_id"] for j in jobs] == ["a0", "b0"]
+        # The shared envelope still reaches every job.
+        assert all(j["additional_parameters"] == {"nodes": [4, 4]} for j in jobs)
+
+    def test_packed_marker_only_when_packed(self):
+        entry = b'{"job_id":"x"}'
+        assert jobs_frame([entry]) == encode(
+            {"type": "jobs", "jobs": [{"job_id": "x"}]})
+        assert b'"packed":true' in jobs_frame([entry], packed=True)
+        assert b'"packed"' not in jobs2_frame([], [entry])
+        assert b'"packed":true' in jobs2_frame([], [entry], packed=True)
+
+
+# ---------------------------------------------------------------------------
+# Broker dispatch: grouping, linger, DRR, placement step
+# ---------------------------------------------------------------------------
+
+
+class TestPackedDispatch:
+    def test_compatibility_key_grouping_never_mixes_configs(self):
+        """Two tenants sharing a config pack into ONE window; a third
+        tenant with a different config gets its own window."""
+        broker = JobBroker(port=0, pack_windows=True, pack_linger_ms=20).start()
+        try:
+            port = broker.address[1]
+            for sid in ("a", "b", "c"):
+                broker.open_session(sid)
+            stub = _StubWorker(port, capacity=16, caps=WIRE_CAPS)
+            try:
+                stub.ready(16)
+                g = _genomes(6, seed=5)
+                broker.submit({"a0": _payload(g[0]), "a1": _payload(g[1])},
+                              session="a")
+                broker.submit({"b0": _payload(g[2]), "b1": _payload(g[3])},
+                              session="b")
+                broker.submit({"c0": _payload(g[4], params={"nodes": [3, 5]}),
+                               "c1": _payload(g[5], params={"nodes": [3, 5]})},
+                              session="c")
+                frames = [decode(stub.recv_raw()), decode(stub.recv_raw())]
+                windows = [expand_jobs2(f) for f in frames]
+                assert all(f.get("packed") is True for f in frames)
+                by_ids = {frozenset(j["job_id"] for j in w) for w in windows}
+                assert by_ids == {frozenset({"a0", "a1", "b0", "b1"}),
+                                  frozenset({"c0", "c1"})}
+                for w in windows:  # a window never mixes configs
+                    assert len({str(j["additional_parameters"]) for j in w}) == 1
+            finally:
+                stub.close()
+        finally:
+            broker.stop()
+
+    def test_linger_deadline_flushes_lone_job(self):
+        broker = JobBroker(port=0, pack_windows=True, pack_linger_ms=60).start()
+        try:
+            port = broker.address[1]
+            stub = _StubWorker(port, capacity=8, caps=WIRE_CAPS)
+            try:
+                stub.ready(8)
+                t0 = time.monotonic()
+                broker.submit({"solo": _payload(_genomes(1, seed=6)[0])})
+                msg = decode(stub.recv_raw())
+                waited = time.monotonic() - t0
+                jobs = expand_jobs2(msg)
+                assert [j["job_id"] for j in jobs] == ["solo"]
+                # Held for the linger deadline (not dispatched instantly),
+                # then flushed promptly (well under 10x the deadline).
+                assert 0.05 <= waited < 0.6, waited
+                stats = broker.pack_stats()
+                assert stats["windows_total"] == 1
+                assert stats["linger_s"]["max"] >= 0.055
+            finally:
+                stub.close()
+        finally:
+            broker.stop()
+
+    def test_drr_deficit_charged_job_by_job_inside_window(self):
+        """Weights 2:1, both tenants backlogged BEFORE any credit exists:
+        the packed window's composition follows the DRR interleave (4:2
+        over six slots), not submit order or tenant batching."""
+        broker = JobBroker(port=0, pack_windows=True,
+                           pack_linger_ms=1000).start()
+        try:
+            port = broker.address[1]
+            broker.open_session("heavy", weight=2.0)
+            broker.open_session("light", weight=1.0)
+            g = _genomes(12, seed=7)
+            broker.submit({f"h{i}": _payload(g[i]) for i in range(6)},
+                          session="heavy")
+            broker.submit({f"l{i}": _payload(g[6 + i]) for i in range(6)},
+                          session="light")
+            stub = _StubWorker(port, capacity=6, caps=WIRE_CAPS)
+            try:
+                stub.ready(6)
+                window = expand_jobs2(decode(stub.recv_raw()))
+                sessions = [j["session"] for j in window]
+                assert len(sessions) == 6
+                assert sessions.count("heavy") == 4
+                assert sessions.count("light") == 2
+                assert _counter_total("packed_windows_total") == 1
+                snap = get_registry().snapshot()
+                by_sid = {c["labels"].get("session"): c["value"]
+                          for c in snap["counters"]
+                          if c["name"] == "packed_jobs_total"}
+                assert by_sid == {"heavy": 4.0, "light": 2.0}
+            finally:
+                stub.close()
+        finally:
+            broker.stop()
+
+    def test_pack_step_mesh_alignment_and_size_classes(self):
+        """The broker-side window sizing mirrors the client's _chunk_jobs:
+        capacity rounded down to the pop-axis multiple for small jobs,
+        singleton windows for big/micro genomes."""
+        broker = JobBroker(port=0, pack_windows=True)
+
+        class W:  # the _pack_step slice of a _Worker
+            capacity = 10
+            mesh = {"pop": 4, "data": 1, "devices": 4}
+
+        assert broker._pack_step(W(), "small") == 8
+        assert broker._pack_step(W(), "big") == 1
+        assert broker._pack_step(W(), "micro") == 1
+        W.mesh = None
+        assert broker._pack_step(W(), "small") == 10
+        W.capacity = 2
+        W.mesh = {"pop": 4}
+        assert broker._pack_step(W(), "small") == 4  # floor at one pop row
+
+    def test_cancel_purges_packer_and_outstanding_drains(self):
+        broker = JobBroker(port=0, pack_windows=True,
+                           pack_linger_ms=10000).start()
+        try:
+            port = broker.address[1]
+            stub = _StubWorker(port, capacity=8, caps=WIRE_CAPS)
+            try:
+                stub.ready(8)  # spare credit lets fill park jobs in the packer
+                g = _genomes(2, seed=8)
+                broker.submit({"x0": _payload(g[0]), "x1": _payload(g[1])})
+                assert _wait(lambda: broker.outstanding()["packed_held"] == 2)
+                broker.cancel(["x0", "x1"])
+                assert _wait(lambda: all(
+                    v == 0 for v in broker.outstanding().values()))
+            finally:
+                stub.close()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Pack-off: wire byte-identity (the regression fence)
+# ---------------------------------------------------------------------------
+
+
+class TestPackOffByteIdentity:
+    @staticmethod
+    def _payloads(n=4):
+        return {f"job-{i:02d}": _payload(g)
+                for i, g in enumerate(_genomes(n, seed=9))}
+
+    def test_v1_frames_byte_identical_with_packing_off(self):
+        broker = JobBroker(port=0).start()  # pack_windows defaults False
+        try:
+            payloads = self._payloads()
+            stub = _StubWorker(broker.address[1], capacity=len(payloads))
+            try:
+                stub.ready(len(payloads))
+                broker.submit(payloads)
+                frame = stub.recv_raw()
+                assert frame == encode({"type": "jobs", "jobs": [
+                    {"job_id": j, **p} for j, p in payloads.items()]})
+                assert b"packed" not in frame
+            finally:
+                stub.close()
+        finally:
+            broker.stop()
+
+    def test_jobs2_frames_carry_no_packed_marker_with_packing_off(self):
+        broker = JobBroker(port=0).start()
+        try:
+            payloads = self._payloads()
+            stub = _StubWorker(broker.address[1], capacity=len(payloads),
+                               caps=WIRE_CAPS)
+            try:
+                stub.ready(len(payloads))
+                broker.submit(payloads)
+                frame = stub.recv_raw()
+                assert b"packed" not in frame
+                msg = decode(frame)
+                assert msg["type"] == "jobs2"
+                assert {j["job_id"] for j in expand_jobs2(msg)} == set(payloads)
+            finally:
+                stub.close()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Worker side: packed windows never re-split
+# ---------------------------------------------------------------------------
+
+
+class TestNoResplit:
+    @staticmethod
+    def _client(capacity):
+        return GentunClient(OneMax, *DATA, host="127.0.0.1", port=1,
+                            capacity=capacity, worker_id="chunker")
+
+    def test_packed_window_within_capacity_is_one_chunk(self):
+        client = self._client(capacity=4)
+        jobs = [{"job_id": f"j{i}", "genes": {"S_1": [1]}} for i in range(4)]
+        chunks = client._chunk_frame({"type": "jobs", "packed": True,
+                                      "jobs": jobs})
+        assert len(chunks) == 1 and chunks[0] == jobs
+        assert _counter_total("packed_window_resplit_total") == 0
+
+    def test_oversized_packed_window_degrades_loudly(self):
+        client = self._client(capacity=2)
+        jobs = [{"job_id": f"j{i}", "genes": {"S_1": [1]}} for i in range(5)]
+        chunks = client._chunk_frame({"type": "jobs", "packed": True,
+                                      "jobs": jobs})
+        # Degrade, never drop: every job still reaches evaluation...
+        assert [j["job_id"] for c in chunks for j in c] == [
+            f"j{i}" for i in range(5)]
+        # ...and the disagreement is loud.
+        assert _counter_total("packed_window_resplit_total") == 1
+
+    def test_unpacked_frames_never_bump_the_resplit_counter(self):
+        client = self._client(capacity=2)
+        jobs = [{"job_id": f"j{i}", "genes": {"S_1": [1]}} for i in range(5)]
+        chunks = client._chunk_frame({"type": "jobs", "jobs": jobs})
+        assert len(chunks) == 3
+        assert _counter_total("packed_window_resplit_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# E2E: demux, quiescence, journal replay of a packed in-flight window
+# ---------------------------------------------------------------------------
+
+
+class TestPackedEndToEnd:
+    def test_two_sessions_share_one_window_and_demux(self):
+        broker = JobBroker(port=0, pack_windows=True, pack_linger_ms=30).start()
+        stop = None
+        try:
+            port = broker.address[1]
+            broker.open_session("a")
+            broker.open_session("b")
+            _, stop, _ = _spawn_worker(OneMax, port, "pk-w0", capacity=8)
+            ga, gb = _genomes(3, seed=10), _genomes(3, seed=11)
+            pa = {f"a{i}": _payload(g) for i, g in enumerate(ga)}
+            pb = {f"b{i}": _payload(g) for i, g in enumerate(gb)}
+            broker.submit(pa, session="a")
+            broker.submit(pb, session="b")
+            ra = broker.gather(list(pa), timeout=30)
+            rb = broker.gather(list(pb), timeout=30)
+            assert ra == {f"a{i}": _onemax_fitness(g) for i, g in enumerate(ga)}
+            assert rb == {f"b{i}": _onemax_fitness(g) for i, g in enumerate(gb)}
+            stats = broker.pack_stats()
+            assert stats["cross_session_windows"] >= 1
+            assert stats["jobs_total"] == 6
+            assert all(v == 0 for v in broker.outstanding().values())
+            # statusz surfaces the pack plane for gentun_top.
+            assert broker._ops_status()["packing"]["windows_total"] >= 1
+        finally:
+            if stop is not None:
+                stop.set()
+            broker.stop()
+
+    def test_journal_replay_of_packed_inflight_window(self, tmp_path):
+        """A packed cross-session window is in flight (dispatched to a
+        never-acking stub) when the broker dies.  Replay re-adopts the
+        window as its constituent per-session jobs, a real worker picks
+        them up, and each lands exactly once in its own session."""
+        port = _free_port()
+        broker = JobBroker(port=port, pack_windows=True, pack_linger_ms=20,
+                           journal_path=str(tmp_path / "pack.journal"),
+                           journal_fsync_interval=0.01).start()
+        stop = None
+        try:
+            broker.open_session("a")
+            broker.open_session("b")
+            stub = _StubWorker(port, capacity=4, caps=WIRE_CAPS)
+            stub.ready(4)
+            ga, gb = _genomes(2, seed=12), _genomes(2, seed=13)
+            pa = {f"a{i}": _payload(g) for i, g in enumerate(ga)}
+            pb = {f"b{i}": _payload(g) for i, g in enumerate(gb)}
+            broker.submit(pa, session="a")
+            broker.submit(pb, session="b")
+            window = expand_jobs2(decode(stub.recv_raw()))
+            assert {j["session"] for j in window} == {"a", "b"}
+            time.sleep(0.05)  # let the journal's dispatch records fsync
+            broker.kill()
+            stub.close()
+            broker.start()
+            assert broker._ops_status()["epoch"] == 2
+            # Replay returned every job of the torn window to its session's
+            # queue; a fresh packer re-packs them for the new worker.
+            _, stop, _ = _spawn_worker(OneMax, port, "pk-w1", capacity=4)
+            ra = broker.gather(list(pa), timeout=30)
+            rb = broker.gather(list(pb), timeout=30)
+            assert ra == {f"a{i}": _onemax_fitness(g) for i, g in enumerate(ga)}
+            assert rb == {f"b{i}": _onemax_fitness(g) for i, g in enumerate(gb)}
+            assert all(v == 0 for v in broker.outstanding().values())
+        finally:
+            if stop is not None:
+                stop.set()
+            broker.stop()
