@@ -388,6 +388,13 @@ class JobBroker:
         # _tele_enqueued; a requeue removes the stamp (the job is no longer
         # dispatched).
         self._tele_dispatched: Dict[str, float] = {}
+        # TTFD anchors (loop-thread writes, snapshot reads): per-session
+        # monotonic stamps of the FIRST submit and FIRST worker handoff,
+        # feeding session_ttfd() and the session_stats wire reply's
+        # ttfd_s.  Always maintained (one dict-membership check per job,
+        # not per frame); cleared on session close.
+        self._first_submit_t: Dict[str, float] = {}
+        self._first_dispatch_t: Dict[str, float] = {}
 
         # Cross-thread results channel
         self._cond = threading.Condition()
@@ -759,6 +766,11 @@ class JobBroker:
             self._job_genome[job_id] = gk
             self._sched.push(sid, job_id)
             sess.submitted += 1
+            if sid not in self._first_submit_t:
+                # TTFD anchor (telemetry/canary.py): the session's FIRST
+                # submit.  One dict-membership check per job; cleared on
+                # session close so a reopened id re-anchors.
+                self._first_submit_t[sid] = now
             if tele:
                 self._tele_enqueued[job_id] = now
         if quarantined:
@@ -968,17 +980,20 @@ class JobBroker:
     # -- session API (multi-tenant; sessions.py) ---------------------------
 
     def open_session(self, session_id: Optional[str] = None, weight: float = 1.0,
-                     max_in_flight: Optional[int] = None) -> str:
+                     max_in_flight: Optional[int] = None,
+                     tag: Optional[str] = None) -> str:
         """Open (or re-attach to) a search session and return its id.
 
         ``weight`` sets the tenant's fair-share priority (a weight-2
         session gets 2× the dispatch share of a weight-1 neighbor while
         both are backlogged); ``max_in_flight`` caps how many of its jobs
-        may be dispatched at once regardless of share.  Safe from any
-        thread; idempotent for an open id.
+        may be dispatched at once regardless of share.  ``tag="canary"``
+        marks a probe session the broker keeps out of tenant-facing SLI
+        series (tags are not journaled — probe sessions reopen fresh after
+        a restart).  Safe from any thread; idempotent for an open id.
         """
         sess = self._registry.open(session_id, weight=weight,
-                                   max_in_flight=max_in_flight)
+                                   max_in_flight=max_in_flight, tag=tag)
         if self._journal is not None:
             jrn, loop = self._journal, self._loop
 
@@ -1007,6 +1022,8 @@ class JobBroker:
         def _do():
             if self._journal is not None:
                 self._journal.record_session_close(sid)
+            self._first_submit_t.pop(sid, None)
+            self._first_dispatch_t.pop(sid, None)
             ids = {j for j, s in self._job_session.items() if s == sid}
             if ids:
                 self._cancel_ids(ids)
@@ -1024,6 +1041,21 @@ class JobBroker:
                 queued=self._sched.session_depth(s.session_id))
             for s in self._registry.list()
         }
+
+    def session_ttfd(self, session_id: Optional[str] = None) -> Optional[float]:
+        """Time-to-first-dispatch for this session: seconds between its
+        FIRST submit and the FIRST of its jobs handed to a worker, or
+        None until both have happened.  The canary plane's
+        ``canary_ttfd_seconds`` SLI — the user-visible "how long before
+        the fleet started my work" signal that queue depth alone can't
+        give.  Snapshot read; monotonic stamps share one clock domain
+        (this process), so the difference is exact."""
+        sid = str(session_id) if session_id else DEFAULT_SESSION
+        t0 = self._first_submit_t.get(sid)
+        t1 = self._first_dispatch_t.get(sid)
+        if t0 is None or t1 is None:
+            return None
+        return max(0.0, t1 - t0)
 
     def session_capacity(self, session_id: Optional[str] = None) -> int:
         """This session's share of :meth:`fleet_capacity`.
@@ -1336,6 +1368,11 @@ class JobBroker:
         if sessions:
             inflight = self._inflight_by_session()
             for s in sessions:
+                if s.tag == "canary":
+                    # Probe sessions are invisible to tenant-facing SLI
+                    # series: no per-session flow gauges (the canary plane
+                    # publishes its own canary_* instruments instead).
+                    continue
                 sid = s.session_id
                 reg.gauge("session_in_flight", session=sid).set(inflight.get(sid, 0))
                 reg.gauge("session_queue_depth", session=sid).set(
@@ -1415,8 +1452,14 @@ class JobBroker:
         # through this pass; the next _dispatch recomputes from the worker
         # table, so the count can never drift.
         inflight = self._inflight_by_session()
+        sessions = self._registry.list()
         quotas = {s.session_id: s.max_in_flight
-                  for s in self._registry.list() if s.max_in_flight is not None}
+                  for s in sessions if s.max_in_flight is not None}
+        # Canary probe sessions stay out of tenant-facing SLI series
+        # (per-session queue_wait_s below, flow gauges in
+        # _update_flow_gauges); built once per pass from the same registry
+        # snapshot the quota table already walks.
+        canary_sids = {s.session_id for s in sessions if s.tag == "canary"}
 
         def eligible(sid: str) -> bool:
             quota = quotas.get(sid)
@@ -1467,6 +1510,9 @@ class JobBroker:
                     w.credit -= 1
                     w.in_flight.add(job_id)
                     inflight[sid] = inflight.get(sid, 0) + 1
+                    if sid not in self._first_dispatch_t:
+                        # TTFD landing stamp: this session's first handoff.
+                        self._first_dispatch_t[sid] = time.monotonic()
                     if jrn is not None:
                         # THE hot-path journal record: a pre-formatted string
                         # append; fsync is the journal task's, never ours.
@@ -1501,8 +1547,12 @@ class JobBroker:
                             # histogram dashboards can read without span
                             # post-processing (tail-regime pressure signal).
                             # Session-labeled only for tenant jobs, so the
-                            # single-tenant series name never changes.
-                            if sid != DEFAULT_SESSION:
+                            # single-tenant series name never changes; canary
+                            # probes are excluded entirely (their waits are
+                            # the canary plane's own SLIs, never a tenant's).
+                            if sid in canary_sids:
+                                pass
+                            elif sid != DEFAULT_SESSION:
                                 _get_registry().histogram(
                                     "queue_wait_s", session=sid).observe(wait)
                             else:
@@ -1683,10 +1733,14 @@ class JobBroker:
         jrn = self._journal
         packer = self._packer
         reg = _get_registry()
+        canary_sids = {s.session_id for s in self._registry.list()
+                       if s.tag == "canary"}
         batch: List[JobWire] = []
         for sid, job_id in window:
             w.credit -= 1
             w.in_flight.add(job_id)
+            if sid not in self._first_dispatch_t:
+                self._first_dispatch_t[sid] = time.monotonic()
             if jrn is not None:
                 jrn.record_dispatch(job_id)
             reg.counter(
@@ -1695,7 +1749,8 @@ class JobBroker:
                     self._payloads[job_id].get("additional_parameters"),
                     int((w.mesh or {}).get("devices") or 1)),
             ).inc()
-            reg.counter("packed_jobs_total", session=sid).inc()
+            if sid not in canary_sids:
+                reg.counter("packed_jobs_total", session=sid).inc()
             if tele:
                 attrs = {"worker": w.worker_id}
                 if sid != DEFAULT_SESSION:
@@ -1708,7 +1763,9 @@ class JobBroker:
                         trace=self._payloads[job_id].get("trace"),
                         attrs=attrs,
                     )
-                    if sid != DEFAULT_SESSION:
+                    if sid in canary_sids:
+                        pass  # canary probes never feed tenant SLI series
+                    elif sid != DEFAULT_SESSION:
                         reg.histogram("queue_wait_s", session=sid).observe(wait)
                     else:
                         reg.histogram("queue_wait_s").observe(wait)
@@ -2358,10 +2415,15 @@ class JobBroker:
                         quota = None if quota is None else int(quota)
                     except (TypeError, ValueError):
                         quota = None
+                    # OPTIONAL tag ("canary"): classification only — never
+                    # journaled, bounded so a hostile frame can't balloon
+                    # the registry.
+                    tag = msg.get("tag")
+                    tag = str(tag)[:64] if tag else None
                     try:
                         sess = self._registry.open(
                             msg.get("session"), weight=weight,
-                            max_in_flight=quota, remote=True)
+                            max_in_flight=quota, remote=True, tag=tag)
                     except UnknownSessionError as e:  # reopening a closed id
                         _reject(msg.get("session"), str(e))
                         continue
@@ -2435,14 +2497,21 @@ class JobBroker:
                     sid = str(msg.get("session") or DEFAULT_SESSION)
                     if msg.get("reset_chips") is True:
                         self.reset_chips_seen()
-                    writer.write(encode({
+                    stats_reply = {
                         "type": "session_stats",
                         "session": sid,
                         "capacity": self.session_capacity(sid),
                         "prefetch": self.session_prefetch(sid),
                         "mesh_pop": self.fleet_mesh_pop(),
                         "chips": self.chips_seen(),
-                    }))
+                    }
+                    ttfd = self.session_ttfd(sid)
+                    if ttfd is not None:
+                        # OPTIONAL field (absent until the session's first
+                        # dispatch, so pre-dispatch replies keep the old
+                        # byte layout): the canary's canary_ttfd_seconds.
+                        stats_reply["ttfd_s"] = round(ttfd, 6)
+                    writer.write(encode(stats_reply))
                 elif mtype == "ping":
                     pass
                 else:

@@ -1442,7 +1442,11 @@ class GentunClient:
         groups: Dict[Any, List[Dict[str, Any]]] = {}
         for job in jobs:
             try:
-                key = _freeze(job.get("additional_parameters") or {})
+                # no_memo jobs (protocol.py "Canary messages": the canary
+                # plane's dedup bypass) must never share a Population — and
+                # therefore a fitness cache — with memoizing jobs.
+                key = (_freeze(job.get("additional_parameters") or {}),
+                       bool(job.get("no_memo")))
                 hash(key)
             except TypeError:
                 key = ("__unhashable__", id(job))
@@ -1486,13 +1490,19 @@ class GentunClient:
                     self._try_send_fail(job["job_id"], f"build: {e!r}")
             if not individuals:
                 continue
+            # Canary dedup bypass: a no_memo group neither consults nor
+            # publishes to the shared fitness store — every evaluation is
+            # real, so a sealed golden genome keeps exercising the full
+            # training path instead of memoizing after its first probe.
+            no_memo = bool(group[0].get("no_memo"))
             pop = Population(
                 self.species,
                 x_train=self.x_train,
                 y_train=self.y_train,
                 individual_list=individuals,
                 additional_parameters=shared_params,
-                fitness_cache=self._store_cache,  # None ⇒ fresh per-group cache
+                # None ⇒ fresh per-group cache (a no_memo group gets one too)
+                fitness_cache=None if no_memo else self._store_cache,
             )
             try:
                 inj = self._injector
@@ -1504,7 +1514,7 @@ class GentunClient:
                 # and same-session accumulated measurements aren't cross-run
                 # reuse — this log exists to prove the latter.
                 store_hits = 0
-                if self._store_cache is not None:
+                if self._store_cache is not None and not no_memo:
                     store_hits = sum(
                         1 for ind in individuals
                         if pop._safe_cache_key(ind) in self._store_keys
@@ -1558,7 +1568,13 @@ class GentunClient:
                     )
                 entries = []
                 for job, ind in zip(ok_jobs, individuals):
-                    entry = {"job_id": job["job_id"], "fitness": ind.get_fitness()}
+                    fitness = ind.get_fitness()
+                    if inj is not None and inj.take_fitness_corrupt(job["job_id"]):
+                        # fitness_corrupt (faults.py): the eval succeeded but
+                        # the reported number is wrong — the silent-corruption
+                        # class only the canary's bit-equality check catches.
+                        fitness = inj.corrupt_fitness(fitness)
+                    entry = {"job_id": job["job_id"], "fitness": fitness}
                     if job.get("session"):
                         # Echo the tenant tag (OPTIONAL; the broker keys on
                         # job_id — the echo is for wire-level attribution).

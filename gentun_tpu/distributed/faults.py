@@ -19,7 +19,7 @@ Hook points and the fault kinds each supports:
 ``client_send``       drop_connection, delay, corrupt, duplicate_result
 ``client_recv``       drop_connection, delay, corrupt
 ``client_connect``    drop_connection (refuse), delay
-``worker_pre_eval``   fail_eval, hang, delay            (per job)
+``worker_pre_eval``   fail_eval, hang, delay, fitness_corrupt (per job)
 ``master_boundary``   kill_master                       (per generation)
 ``journal_write``     journal_io_error, broker_crash    (per journal drain)
 ====================  ==================================================
@@ -54,6 +54,12 @@ does that; a lost frame in the real world is a broken connection):
   ``DispatchJournal.crash_requested`` trips, which the broker's journal
   task turns into an abrupt :meth:`JobBroker.kill`.  Restart-with-replay
   must re-adopt every open job through the at-least-once path.
+- ``fitness_corrupt`` — the evaluation SUCCEEDS but the worker reports a
+  deterministically perturbed fitness (stale cache entry, packed-window
+  demux bug, silent numeric corruption — the failure class NO transport
+  machinery can catch, because the frame is well-formed).  Only the
+  canary plane's golden-genome bit-equality check
+  (``gentun_tpu/telemetry/canary.py``) detects it.
 
 Zero-cost when disabled: every production hook site is a single
 ``if self._injector is not None`` attribute check — no allocation, no
@@ -64,6 +70,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -85,6 +92,7 @@ HOOKS = (
 KINDS = (
     "drop_connection", "delay", "corrupt", "hang", "fail_eval",
     "duplicate_result", "kill_master", "journal_io_error", "broker_crash",
+    "fitness_corrupt",
 )
 
 #: Which kinds make sense at which hook — validated at FaultSpec build so a
@@ -95,7 +103,7 @@ _HOOK_KINDS: Dict[str, tuple] = {
     "client_send": ("drop_connection", "delay", "corrupt", "duplicate_result"),
     "client_recv": ("drop_connection", "delay", "corrupt"),
     "client_connect": ("drop_connection", "delay"),
-    "worker_pre_eval": ("fail_eval", "hang", "delay"),
+    "worker_pre_eval": ("fail_eval", "hang", "delay", "fitness_corrupt"),
     "master_boundary": ("kill_master",),
     "journal_write": ("journal_io_error", "broker_crash"),
 }
@@ -246,6 +254,7 @@ class FaultInjector:
         self._counts = [0] * len(plan.specs)
         self.fired: List[Dict[str, Any]] = []
         self._hang_until = 0.0
+        self._corrupt_jobs: set = set()
 
     # -- matching ----------------------------------------------------------
 
@@ -375,10 +384,43 @@ class FaultInjector:
             return
         if s.kind == "fail_eval":
             raise RuntimeError(f"injected eval failure (job {job.get('job_id')})")
+        if s.kind == "fitness_corrupt":
+            # The eval proceeds normally; the worker's result path consumes
+            # this mark (take_fitness_corrupt) and perturbs the reported
+            # fitness AFTER evaluation — a well-formed frame with a wrong
+            # number, invisible to every transport check.
+            with self._lock:
+                self._corrupt_jobs.add(job.get("job_id"))
+            return
         # hang: hold the jobs, stop heartbeating (the heartbeat loop checks
         # heartbeats_suppressed), and let the broker's reaper declare us dead.
         self._hang_until = time.monotonic() + s.duration
         time.sleep(s.duration)
+
+    def take_fitness_corrupt(self, job_id: Any) -> bool:
+        """Consume (once) a ``fitness_corrupt`` mark left by
+        :meth:`worker_pre_eval` for this job."""
+        with self._lock:
+            if job_id in self._corrupt_jobs:
+                self._corrupt_jobs.discard(job_id)
+                return True
+            return False
+
+    @staticmethod
+    def corrupt_fitness(value: Any) -> float:
+        """The deterministic perturbation a ``fitness_corrupt`` fault
+        applies: finite fitnesses shift by +1.0, anything else becomes
+        1.0 — always a well-formed float, never bit-equal to the truth."""
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return 1.0
+        if v != v or v in (float("inf"), float("-inf")):
+            return 1.0
+        out = v + 1.0
+        if out == v:  # |v| swamps the +1.0 — nudge one ulp toward zero
+            out = math.nextafter(v, 0.0)
+        return out
 
     def heartbeats_suppressed(self) -> bool:
         """True while a ``hang`` fault is in force (checked by the client's

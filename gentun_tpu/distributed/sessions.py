@@ -110,14 +110,20 @@ class SearchSession:
     __slots__ = ("session_id", "weight", "max_in_flight", "remote", "closed",
                  "created_at", "submitted", "completed", "failed", "rejected",
                  "requeued", "poison_counts", "quarantine", "owner",
-                 "undelivered")
+                 "undelivered", "tag")
 
     def __init__(self, session_id: str, weight: float = 1.0,
-                 max_in_flight: Optional[int] = None, remote: bool = False):
+                 max_in_flight: Optional[int] = None, remote: bool = False,
+                 tag: Optional[str] = None):
         self.session_id = session_id
         self.weight = max(1e-6, float(weight))
         self.max_in_flight = None if max_in_flight is None else max(1, int(max_in_flight))
         self.remote = remote
+        #: Free-form classification ("canary" ⇒ the broker keeps this
+        #: session out of tenant-facing SLI series).  Not journaled: a
+        #: tagged session is transient by design and reopens fresh after
+        #: a broker restart.
+        self.tag = str(tag) if tag else None
         self.closed = False
         self.created_at = time.monotonic()
         self.submitted = 0
@@ -168,7 +174,7 @@ class SearchSession:
         return True
 
     def snapshot(self, in_flight: int = 0, queued: int = 0) -> Dict[str, Any]:
-        return {
+        snap = {
             "session": self.session_id,
             "weight": self.weight,
             "max_in_flight": self.max_in_flight,
@@ -183,6 +189,9 @@ class SearchSession:
             "in_flight": in_flight,
             "queued": queued,
         }
+        if self.tag is not None:
+            snap["tag"] = self.tag
+        return snap
 
 
 class SessionRegistry:
@@ -197,7 +206,7 @@ class SessionRegistry:
 
     def open(self, session_id: Optional[str] = None, weight: float = 1.0,
              max_in_flight: Optional[int] = None,
-             remote: bool = False) -> SearchSession:
+             remote: bool = False, tag: Optional[str] = None) -> SearchSession:
         """Create a session, or ATTACH to an existing open one (idempotent
         — re-opening updates weight/quota in place, so a reconnecting
         tenant re-asserts its priority).  Re-opening a CLOSED id raises:
@@ -212,9 +221,12 @@ class SessionRegistry:
                 sess.weight = max(1e-6, float(weight))
                 sess.max_in_flight = (None if max_in_flight is None
                                       else max(1, int(max_in_flight)))
+                if tag is not None:
+                    sess.tag = str(tag)
                 return sess
             sess = SearchSession(sid, weight=weight,
-                                 max_in_flight=max_in_flight, remote=remote)
+                                 max_in_flight=max_in_flight, remote=remote,
+                                 tag=tag)
             self._sessions[sid] = sess
             return sess
 
@@ -497,9 +509,9 @@ class SessionClient:
         self._replies: Deque[Dict[str, Any]] = deque()
         self._closed = False
         self._user_closed = False
-        #: sessions this client opened (id -> (weight, max_in_flight)) —
-        #: the re-attach worklist after a broker restart.
-        self._sessions: Dict[str, Tuple[float, Optional[int]]] = {}
+        #: sessions this client opened (id -> (weight, max_in_flight, tag))
+        #: — the re-attach worklist after a broker restart.
+        self._sessions: Dict[str, Tuple[float, Optional[int], Optional[str]]] = {}
         self._send({"type": "hello", "role": "client", "token": token})
         reply = self._recv_direct()
         if reply.get("type") != "welcome":
@@ -591,12 +603,14 @@ class SessionClient:
                                 max(0.0, deadline - time.monotonic())))
                             continue
                         return False  # auth/protocol rejection — permanent
-                    for sid, (weight, mif) in list(self._sessions.items()):
+                    for sid, (weight, mif, tag) in list(self._sessions.items()):
                         msg: Dict[str, Any] = {"type": "session_open",
                                                "session": sid,
                                                "weight": float(weight)}
                         if mif is not None:
                             msg["max_in_flight"] = int(mif)
+                        if tag is not None:
+                            msg["tag"] = str(tag)
                         sock.sendall(encode(msg))
                         while True:  # drain until THIS re-attach acks
                             m = decode(rfile.readline(MAX_MESSAGE_BYTES + 2)
@@ -722,19 +736,25 @@ class SessionClient:
     # -- tenant API --------------------------------------------------------
 
     def open_session(self, session_id: Optional[str] = None, weight: float = 1.0,
-                     max_in_flight: Optional[int] = None) -> str:
+                     max_in_flight: Optional[int] = None,
+                     tag: Optional[str] = None) -> str:
         if self._ring is not None:
             # Mint the id client-side when absent: placement needs the id
             # before the wire does.
             sid = str(session_id) if session_id else f"s-{uuid.uuid4().hex[:12]}"
             self._child(self._home_of(sid)).open_session(
-                sid, weight=weight, max_in_flight=max_in_flight)
+                sid, weight=weight, max_in_flight=max_in_flight, tag=tag)
             return sid
         msg: Dict[str, Any] = {"type": "session_open", "weight": float(weight)}
         if session_id:
             msg["session"] = str(session_id)
         if max_in_flight is not None:
             msg["max_in_flight"] = int(max_in_flight)
+        if tag is not None:
+            # OPTIONAL classification ("canary"): the broker keeps tagged
+            # sessions out of tenant-facing SLI series.  Absent ⇒ the frame
+            # is byte-identical to the pre-tag protocol.
+            msg["tag"] = str(tag)
         with self._cond:
             since = self._error_seq
         self._send(msg)
@@ -742,7 +762,8 @@ class SessionClient:
             "session_ok", since=since,
             session=str(session_id) if session_id else None)["session"])
         self._sessions[sid] = (float(weight), None if max_in_flight is None
-                               else int(max_in_flight))
+                               else int(max_in_flight),
+                               str(tag) if tag is not None else None)
         return sid
 
     def close_session(self, session_id: str) -> None:

@@ -216,6 +216,43 @@ def default_rules(scale: float = 1.0) -> List[SloRule]:
             description="session queue depth grew monotonically across "
                         "the window — submission outpacing the fleet",
         ),
+        # -- canary plane (telemetry/canary.py): black-box probes of the
+        # REAL serving path.  Error burn says "users can't get work
+        # through"; latency says "they can, slowly"; correctness is the
+        # zero-tolerance page — a golden genome came back with a fitness
+        # that is not bit-equal to its sealed value, i.e. the fleet is
+        # returning wrong answers and every live search is suspect.
+        SloRule(
+            name="canary_error_burn", kind="increase",
+            series="canary_errors_total",
+            threshold=0.0, op=">",
+            window_s=60.0 * s, for_s=5.0 * s, clear_for_s=30.0 * s,
+            subject="fleet", severity="warn",
+            description="canary probes failed inside the window (open/"
+                        "submit/result/verify stage) — the serving path "
+                        "is broken the way a tenant would see it",
+        ),
+        SloRule(
+            name="canary_latency", kind="ratio",
+            series="canary_e2e_seconds_sum",
+            denom="canary_e2e_seconds_count",
+            threshold=30.0, op=">",
+            window_s=120.0 * s, for_s=10.0 * s, clear_for_s=60.0 * s,
+            subject="fleet", severity="warn",
+            description="mean canary end-to-end probe latency exceeded "
+                        "30 s — queueing or evaluation is degraded for "
+                        "everyone, not just the probe",
+        ),
+        SloRule(
+            name="canary_correctness", kind="increase",
+            series="canary_fitness_drift_total",
+            threshold=0.0, op=">",
+            window_s=60.0 * s, for_s=0.0, clear_for_s=60.0 * s,
+            subject="fleet", severity="page",
+            description="a golden genome's fitness was NOT bit-equal to "
+                        "its sealed value — the fleet is lying; quarantine "
+                        "results since the last clean probe",
+        ),
     ]
 
 

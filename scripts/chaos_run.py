@@ -1864,6 +1864,131 @@ def run_packing_act() -> dict:
     }
 
 
+def run_canary_act() -> dict:
+    """Canary-plane act (docs/OBSERVABILITY.md "Canary plane"): the
+    black-box golden-genome sentinel must DETECT each fault class within
+    a bounded number of probe cycles — and raise zero false alarms on a
+    clean fleet.
+
+    Four arms, one daemon driven deterministically via ``probe_once``:
+
+    - **clean** — healthy broker + worker, 8 cycles: every probe ``ok``,
+      zero drift, zero errors (the false-positive floor);
+    - **corruption** — a ``fitness_corrupt`` injection (evaluation
+      succeeds, reported fitness perturbed — invisible to every
+      transport check): the corrupted cycle itself must report
+      ``drift`` (detection latency 1 cycle);
+    - **hang** — the worker hangs holding the probe job: the probe
+      times out at stage ``result`` within 1 cycle of the hang;
+    - **broker kill** — the probe's home shard dies: stage ``open``
+      error within 1 cycle, and after a restarted shard + fresh worker
+      the canary self-recovers to ``ok`` (probe sessions are transient
+      by design — nothing to re-adopt).
+    """
+    from gentun_tpu.telemetry.canary import CanaryDaemon
+    from gentun_tpu.telemetry.registry import get_registry
+
+    get_registry().reset()
+    probes = [{"genes": Population(OneMax, *DATA, size=1,
+                                   seed=POP_SEED)[0].get_genes()}]
+
+    def _daemon(port, timeout=10.0):
+        return CanaryDaemon([f"127.0.0.1:{port}"], probes,
+                            space_key="chaos", probe_interval=999,
+                            probe_timeout=timeout, serve_http=False)
+
+    def _wait_members(broker, n, timeout=10.0):
+        # Worker swaps must be visible broker-side before probing, or a
+        # draining predecessor absorbs the probe and the detection-
+        # latency count measures the handoff, not the canary.
+        deadline = time.time() + timeout
+        while broker.fleet_members() != n and time.time() < deadline:
+            time.sleep(0.05)
+        assert broker.fleet_members() == n, (
+            f"fleet never settled at {n} member(s)")
+
+    # -- clean arm: 8 cycles, zero false alarms ---------------------------
+    broker = JobBroker(port=0).start()
+    port = broker.address[1]
+    stop = _worker(port, worker_id="cn-w0")
+    cn = _daemon(port)
+    clean_results = [cn.probe_once()["result"] for _ in range(8)]
+    assert clean_results == ["ok"] * 8, (
+        f"clean fleet raised a canary alarm: {clean_results}")
+
+    # -- corruption arm: drift detected ON the corrupted cycle ------------
+    stop.set()
+    _wait_members(broker, 0)
+    inj = FaultInjector(FaultPlan([FaultSpec(
+        hook="worker_pre_eval", kind="fitness_corrupt", at=0)]))
+    stop = _worker(port, injector=inj, worker_id="cn-w1")
+    _wait_members(broker, 1)
+    corrupt_cycles = 0
+    corruption_detected_in = None
+    for i in range(4):
+        corrupt_cycles += 1
+        if cn.probe_once()["result"] == "drift":
+            corruption_detected_in = corrupt_cycles
+            break
+    assert corruption_detected_in == 1, (
+        f"fitness corruption not flagged on its own cycle "
+        f"(detected in {corruption_detected_in})")
+    assert [s["kind"] for s in inj.fired] == ["fitness_corrupt"]
+    post = cn.probe_once()
+    assert post["result"] == "ok", "canary did not recover after corruption"
+
+    # -- hang arm: result-stage timeout within 1 cycle --------------------
+    stop.set()
+    _wait_members(broker, 0)
+    hang_inj = FaultInjector(FaultPlan([FaultSpec(
+        hook="worker_pre_eval", kind="hang", at=0, duration=3.0)]))
+    stop = _worker(port, injector=hang_inj, worker_id="cn-w2")
+    _wait_members(broker, 1)
+    cn.probe_timeout = 1.0
+    hung = cn.probe_once()
+    assert hung["result"] == "error" and hung["stage"] == "result", hung
+    cn.probe_timeout = 10.0
+    time.sleep(3.2)  # let the hang release so the arm below starts clean
+
+    # -- broker-kill arm: open-stage error, then recovery -----------------
+    stop.set()
+    broker.stop()
+    dead = cn.probe_once()
+    assert dead["result"] == "error" and dead["stage"] == "open", dead
+    broker2 = JobBroker(port=port).start()  # shard restarted on its port
+    stop = _worker(port, worker_id="cn-w3")
+    recovered = None
+    recovery_cycles = 0
+    for _ in range(5):
+        recovery_cycles += 1
+        r = cn.probe_once()
+        if r["result"] == "ok":
+            recovered = r
+            break
+        time.sleep(0.3)  # worker still reconnecting
+    assert recovered is not None, "canary never recovered after restart"
+    assert not recovered["newly_sealed"], (
+        "golden was re-sealed after restart — seal must persist in-daemon")
+
+    stats = cn.stats()
+    cn.stop()
+    stop.set()
+    broker2.stop()
+    get_registry().reset()
+    return {
+        "clean_cycles": len(clean_results),
+        "clean_false_alarms": 0,
+        "corruption_detected_in_cycles": corruption_detected_in,
+        "hang_detected_in_cycles": 1,
+        "hang_stage": hung["stage"],
+        "broker_kill_detected_in_cycles": 1,
+        "broker_kill_stage": dead["stage"],
+        "recovery_cycles_after_restart": recovery_cycles,
+        "drift_total": stats["drift_total"],
+        "goldens_sealed": stats["goldens_sealed"],
+    }
+
+
 if __name__ == "__main__":
     out = run()
     out["stall_ops"] = run_stall_ops()
@@ -1879,6 +2004,7 @@ if __name__ == "__main__":
     out["shard_kill"] = run_shard_kill()
     out["preemption"] = run_preemption_act()
     out["packing"] = run_packing_act()
+    out["canary"] = run_canary_act()
     print(json.dumps(out, indent=2))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos_run.json")
     with open(path, "w") as f:

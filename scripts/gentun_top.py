@@ -630,6 +630,38 @@ def render_fleet(base: str, statusz, alertz, ringz, metrics_text,
     if interesting:
         lines.append(f"{B}fleet gauges{X}  " + "  ".join(
             f"{n}={v:g}" for n, v in interesting))
+
+    # Canary panel (docs/OBSERVABILITY.md "Canary plane"): the black-box
+    # verdict — golden-genome probes through the real serving path.
+    # Present only when a canary daemon is pushing.  Non-zero drift is
+    # PAGE-red: the fleet returned a wrong answer for a known genome.
+    probes = _parse_labeled(metrics_text or "", "canary_probes_total",
+                            "result")
+    if probes or any(n.startswith("canary_") for n in counters):
+        mc = _parse_counters(metrics_text or "")
+        drift = counters.get("canary_fitness_drift_total", 0.0)
+        errors = counters.get("canary_errors_total", 0.0)
+        e2e_n = mc.get("canary_e2e_seconds_count", 0.0)
+        e2e = (f"~{mc.get('canary_e2e_seconds_sum', 0.0) / e2e_n:.2f}s"
+               if e2e_n else "-")
+        ttfd_n = mc.get("canary_ttfd_seconds_count", 0.0)
+        ttfd = (f"~{mc.get('canary_ttfd_seconds_sum', 0.0) / ttfd_n * 1e3:.0f}ms"
+                if ttfd_n else "-")
+        verdict = (f"{R}DRIFT ×{drift:g}{X}" if drift
+                   else f"{G}bit-clean{X}")
+        lines.append(
+            f"{B}canary{X}  {verdict}  "
+            f"probes {sum(probes.values()):g} "
+            f"(ok {probes.get('ok', 0):g}, drift {probes.get('drift', 0):g}, "
+            f"error {probes.get('error', 0):g})  e2e {e2e}  ttfd {ttfd}  "
+            f"goldens {gauges.get('canary_goldens_sealed', 0):g}")
+        if errors:
+            stages = _parse_labeled(metrics_text or "", "canary_errors_total",
+                                    "stage")
+            lines.append(f"  {D}errors by stage: " + "  ".join(
+                f"{s} {n:g}" for s, n in sorted(stages.items(),
+                                                key=lambda kv: -kv[1]))
+                + f"{X}")
     return "\n".join(lines)
 
 

@@ -287,6 +287,113 @@ def extract_dataset(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "genomes": len(genes_by_genome)}
 
 
+def slo_timeline(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reconstruct the alert/scale/canary timeline from telemetry alone.
+
+    Pairs each SLO ``fire`` with its ``clear`` per (rule, subject) — one
+    *episode* each, carrying both ``transition_seq`` edges, the duration,
+    and everything that happened inside the window: autoscaler ``scale``
+    decisions (with their evidence ring tails) and canary drift events.
+    An episode with no ``clear`` in the ledger is reported ``open`` —
+    exactly the ones an operator is being paged about.
+    """
+    alerts = [r for r in records if r.get("type") == "alert"]
+    scales = [r for r in records if r.get("type") == "scale"]
+    drifts = [r for r in records
+              if r.get("type") == "event" and r.get("name") == "canary_drift"]
+    probes = [r for r in records if r.get("type") == "canary_probe"]
+    alerts.sort(key=lambda r: (r.get("t", 0.0), r.get("transition_seq", 0)))
+
+    episodes: List[Dict[str, Any]] = []
+    open_by_key: Dict[tuple, Dict[str, Any]] = {}
+    for a in alerts:
+        key = (a.get("rule"), a.get("subject"))
+        if a.get("event") == "fire":
+            ep = {
+                "rule": a.get("rule"),
+                "subject": a.get("subject"),
+                "severity": a.get("severity"),
+                "fired_t": a.get("t"),
+                "fire_seq": a.get("transition_seq"),
+                "value": a.get("value"),
+                "threshold": a.get("threshold"),
+                "cleared_t": None,
+                "clear_seq": None,
+                "duration_s": None,
+                "open": True,
+            }
+            episodes.append(ep)
+            open_by_key[key] = ep
+        elif a.get("event") == "clear" and key in open_by_key:
+            ep = open_by_key.pop(key)
+            ep["cleared_t"] = a.get("t")
+            ep["clear_seq"] = a.get("transition_seq")
+            ep["open"] = False
+            if ep["fired_t"] is not None and ep["cleared_t"] is not None:
+                ep["duration_s"] = round(ep["cleared_t"] - ep["fired_t"], 3)
+
+    # Attach what happened inside each episode's window.
+    for ep in episodes:
+        t0 = ep["fired_t"] or 0.0
+        t1 = ep["cleared_t"] if ep["cleared_t"] is not None else float("inf")
+        acts = [s for s in scales
+                if s.get("rule") == ep["rule"] and t0 <= s.get("t", 0.0) <= t1]
+        ep["actions"] = [{
+            "action": s.get("action"),
+            "from": s.get("from"),
+            "to": s.get("to"),
+            "outcome": s.get("outcome"),
+            "t": s.get("t"),
+            "evidence_tail": (s.get("evidence") or [])[-3:],
+        } for s in acts]
+        ep["drifts"] = [d for d in drifts
+                        if t0 <= d.get("t_wall", 0.0) <= t1]
+
+    results = _count_by(probes, "result")
+    return {
+        "episodes": episodes,
+        "summary": {
+            "fires": sum(1 for a in alerts if a.get("event") == "fire"),
+            "clears": sum(1 for a in alerts if a.get("event") == "clear"),
+            "open": sum(1 for e in episodes if e["open"]),
+            "by_severity": _count_by(
+                [e for e in episodes], "severity"),
+            "scale_actions": len(scales),
+            "canary_probes": results,
+            "canary_drift_events": len(drifts),
+        },
+    }
+
+
+def render_slo(timeline: Dict[str, Any]) -> str:
+    L: List[str] = []
+    s = timeline["summary"]
+    L.append("== SLO timeline ==")
+    L.append(f"fires {s['fires']}  clears {s['clears']}  "
+             f"still-open {s['open']}  scale-actions {s['scale_actions']}")
+    if s["canary_probes"]:
+        probes = "  ".join(f"{k}={v}" for k, v in s["canary_probes"].items())
+        L.append(f"canary probes: {probes}  "
+                 f"drift-events {s['canary_drift_events']}")
+    for ep in timeline["episodes"]:
+        dur = ("open" if ep["open"]
+               else f"{ep['duration_s']}s")
+        L.append(f"  [{ep['severity']}] {ep['rule']} subject={ep['subject']} "
+                 f"seq {ep['fire_seq']}->"
+                 f"{ep['clear_seq'] if ep['clear_seq'] is not None else '…'} "
+                 f"({dur})  value={ep['value']} threshold={ep['threshold']}")
+        for a in ep["actions"]:
+            L.append(f"      scale {a['action']}: {a['from']} -> {a['to']} "
+                     f"({a['outcome']})")
+            for pt in a["evidence_tail"]:
+                L.append(f"        evidence {pt}")
+        for d in ep["drifts"]:
+            L.append(f"      drift: {d.get('data')}")
+    if not timeline["episodes"]:
+        L.append("  (no alert transitions in the ledger)")
+    return "\n".join(L)
+
+
 def _count_by(events: List[Dict[str, Any]], field: str) -> Dict[str, int]:
     out: Dict[str, int] = {}
     for e in events:
@@ -388,7 +495,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_ds.add_argument("jsonl")
     p_ds.add_argument("out", nargs="?", default=None,
                       help="output JSONL path (default: stdout)")
+    p_slo = sub.add_parser(
+        "slo",
+        help="reconstruct the alert/scale/canary timeline (fire->clear "
+             "episodes with transition_seq, durations, evidence tails)")
+    p_slo.add_argument("jsonl")
+    p_slo.add_argument("--json", action="store_true",
+                       help="machine-readable JSON instead of text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "slo":
+        timeline = slo_timeline(traceviz.load_jsonl(args.jsonl))
+        if args.json:
+            json.dump(timeline, sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            print(render_slo(timeline))
+        return 0
 
     if args.cmd == "convert":
         trace = traceviz.convert(args.jsonl, args.out)

@@ -352,7 +352,10 @@ class TestPackedDispatch:
                 assert len(sessions) == 6
                 assert sessions.count("heavy") == 4
                 assert sessions.count("light") == 2
-                assert _counter_total("packed_windows_total") == 1
+                # The frame hits the stub's socket before the broker loop
+                # thread reaches the counter bump — poll, don't race it.
+                assert _wait(
+                    lambda: _counter_total("packed_windows_total") == 1)
                 snap = get_registry().snapshot()
                 by_sid = {c["labels"].get("session"): c["value"]
                           for c in snap["counters"]
